@@ -1,0 +1,68 @@
+"""Benchmark: Llama training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no train-throughput number (BASELINE.md "Not
+published"); the north-star target from BASELINE.json is >=40% MFU for
+Llama-family DDP training on v5e. ``vs_baseline`` is therefore measured MFU
+divided by the 0.40 target (>1.0 beats the target).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# v5e (TPU v5 lite) peak bf16 matmul throughput per chip.
+V5E_PEAK_FLOPS = 197e12
+
+
+def main() -> None:
+    import jax
+
+    from ray_tpu.models.llama import LlamaConfig, flops_per_token
+    from ray_tpu.parallel import MeshConfig, ParallelContext
+    from ray_tpu.train.spmd import make_train_fns
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, d_model=2048, n_layers=8,
+                          n_heads=16, n_kv_heads=16, d_ff=5504, max_seq=2048)
+        batch, seq, steps = 8, 2048, 10
+    else:  # CPU smoke fallback so the harness never hard-fails
+        cfg = LlamaConfig.tiny(max_seq=128)
+        batch, seq, steps = 4, 128, 3
+
+    ctx = ParallelContext.create(MeshConfig())  # single chip
+    init, step = make_train_fns(cfg, ctx)
+    state = init(jax.random.PRNGKey(0))
+    toks = jax.device_put(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq),
+                                         dtype=np.int32),
+        ctx.batch_sharding())
+
+    for _ in range(3):  # warmup / compile
+        state, metrics = step(state, toks)
+    float(metrics["loss"])  # host read: block_until_ready alone does not
+    # synchronize on the experimental axon PJRT backend
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, toks)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    mfu = tokens_per_sec * flops_per_token(cfg, seq) / V5E_PEAK_FLOPS
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+A ground-up rebuild of the reference framework's capabilities (distributed
+task/actor/object runtime + Data/Train/Tune/Serve/RLlib) designed for
+JAX/XLA/Pallas/pjit over TPU ICI/DCN. See SURVEY.md for the blueprint.
+"""
+
+from ray_tpu.version import __version__
+
+__all__ = ["__version__"]

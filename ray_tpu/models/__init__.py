@@ -1,0 +1,6 @@
+from ray_tpu.models.llama import (LlamaConfig, flops_per_token, forward,
+                                  init_params, logical_axes, loss_fn,
+                                  param_count)
+
+__all__ = ["LlamaConfig", "forward", "init_params", "logical_axes", "loss_fn",
+           "param_count", "flops_per_token"]

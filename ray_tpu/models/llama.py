@@ -1,0 +1,318 @@
+"""Llama-family decoder-only transformer — the framework's flagship model.
+
+Pure-functional JAX: parameters are a plain pytree with a parallel pytree of
+*logical axis* tuples (see ray_tpu.parallel.sharding); no NN framework layer in
+between, so GSPMD sharding, pipelining, and remat act on explicit structures.
+
+Parallelism composition (all driven by ParallelContext):
+  * dp/fsdp  — batch sharding + GSPMD parameter sharding via logical rules
+  * tp       — Megatron-style hidden-dim sharding via logical rules
+  * sp       — ring attention over the sp axis (manual shard_map region)
+  * pp       — GPipe microbatch schedule (ray_tpu.parallel.pipeline)
+  * ep       — MoE expert sharding (n_experts > 0)
+
+The reference framework carries no model code of its own (models live in
+engines it orchestrates); this model is the workload its north-star targets
+(BASELINE.json: Llama-2-7B DDP ≥40% MFU on v5e-16).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.attention import flash_attention, repeat_kv
+from ray_tpu.ops.moe import moe_ffn
+from ray_tpu.ops.norms import apply_rope, rms_norm, rope_frequencies
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.parallel.context import ParallelContext
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    # MoE: 0 experts = dense FFN in every layer.
+    n_experts: int = 0
+    top_k_experts: int = 2
+    moe_aux_weight: float = 0.01
+    dtype: Any = jnp.bfloat16          # activation/compute dtype
+    param_dtype: Any = jnp.float32     # master parameter dtype
+    remat: bool = True
+    num_microbatches: int = 0          # 0 => equal to pp size
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    # ---- presets ----
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        base = dict(vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+                    n_kv_heads=8, d_ff=14336, max_seq=8192, rope_theta=500000.0)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq=128, dtype=jnp.float32)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    layers: Dict[str, Tuple] = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+    }
+    if cfg.n_experts > 0:
+        layers.update({
+            "router": ("layers", "embed", "expert"),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        layers.update({
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        })
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    L, D, H, KVH = cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.n_kv_heads
+    hd, F, V = cfg.head_dim, cfg.d_ff, cfg.vocab_size
+    pd = cfg.param_dtype
+    ks = iter(jax.random.split(key, 16))
+
+    def norm(shape, k, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(pd)
+
+    layers: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, D), pd),
+        "wq": norm((L, D, H * hd), next(ks)),
+        "wk": norm((L, D, KVH * hd), next(ks)),
+        "wv": norm((L, D, KVH * hd), next(ks)),
+        "wo": norm((L, H * hd, D), next(ks)),
+        "mlp_norm": jnp.ones((L, D), pd),
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        layers.update({
+            "router": norm((L, D, E), next(ks)),
+            "w_gate": norm((L, E, D, F), next(ks)),
+            "w_up": norm((L, E, D, F), next(ks)),
+            "w_down": norm((L, E, F, D), next(ks)),
+        })
+    else:
+        layers.update({
+            "w_gate": norm((L, D, F), next(ks)),
+            "w_up": norm((L, D, F), next(ks)),
+            "w_down": norm((L, F, D), next(ks)),
+        })
+    return {
+        "embed": norm((V, D), next(ks)),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), pd),
+        "lm_head": norm((D, V), next(ks)),
+    }
+
+
+def param_count(cfg: LlamaConfig) -> int:
+    shapes = jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    return sum(int(jnp.prod(jnp.array(l.shape))) for l in jax.tree.leaves(shapes))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _layer_fwd(lp: Dict[str, jax.Array], x: jax.Array, cos, sin, positions,
+               cfg: LlamaConfig, sp_manual: bool) -> jax.Array:
+    B, S, D = x.shape
+    H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dh->bsh", h, lp["wq"].astype(dt))
+    k = jnp.einsum("bsd,dh->bsh", h, lp["wk"].astype(dt))
+    v = jnp.einsum("bsd,dh->bsh", h, lp["wv"].astype(dt))
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, KVH, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, KVH, hd).transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    k = repeat_kv(k, H // KVH)
+    v = repeat_kv(v, H // KVH)
+    if sp_manual:
+        attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+    else:
+        attn = flash_attention(q, k, v, True)
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
+    x = x + jnp.einsum("bsh,hd->bsd", attn, lp["wo"].astype(dt))
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts > 0:
+        flat = h.reshape(B * S, D)
+        out, aux = moe_ffn(flat, lp["router"].astype(dt),
+                           lp["w_up"].astype(dt), lp["w_gate"].astype(dt),
+                           lp["w_down"].astype(dt), top_k=cfg.top_k_experts)
+        x = x + out.reshape(B, S, D)
+    else:
+        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
+        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
+        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                           lp["w_down"].astype(dt))
+        aux = jnp.zeros((), jnp.float32)
+    return x, aux
+
+
+def _stack_fwd(layers_p: Dict[str, Any], x: jax.Array, cos, sin,
+               cfg: LlamaConfig, sp_manual: bool) -> Tuple[jax.Array, jax.Array]:
+    """Scan over a stack of layers (leading 'layers' axis on every leaf).
+
+    Returns (x, summed MoE aux loss across the stack)."""
+    if sp_manual:
+        offset = jax.lax.axis_index("sp") * x.shape[1]
+    else:
+        offset = 0
+    positions = offset + jnp.arange(x.shape[1])
+
+    def body(carry, lp):
+        x, aux_sum = carry
+        x, aux = _layer_fwd(lp, x, cos, sin, positions, cfg, sp_manual)
+        return (x, aux_sum + aux), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    aux0 = (x[(0,) * x.ndim] * 0).astype(jnp.float32)  # inherits x's vma type
+    (x, aux), _ = jax.lax.scan(body, (x, aux0), layers_p)
+    return x, aux
+
+
+def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
+                     cfg: LlamaConfig,
+                     ctx: Optional[ParallelContext] = None
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, S] -> (logits [B, S, V] float32, MoE aux loss scalar)."""
+    dt = cfg.dtype
+    x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+
+    sp = ctx.sp if ctx else 1
+    pp = ctx.pp if ctx else 1
+    sp_manual = sp > 1
+
+    if ctx is not None:
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(ctx.mesh, ctx.activation_spec()))
+
+    if pp > 1:
+        # Reshape stacked layers [L, ...] -> [pp, L/pp, ...] and microbatch.
+        from ray_tpu.parallel.pipeline import gpipe_spmd
+        L = cfg.n_layers
+        assert L % pp == 0, (L, pp)
+        stage_layers = jax.tree.map(
+            lambda p: p.reshape(pp, L // pp, *p.shape[1:]), params["layers"])
+        M = cfg.num_microbatches or pp
+        B = x.shape[0]
+        assert B % M == 0, (B, M)
+        x_mb = x.reshape(M, B // M, *x.shape[1:])
+
+        stage_fn = functools.partial(_stack_fwd, cos=cos, sin=sin, cfg=cfg,
+                                     sp_manual=sp_manual)
+        manual = {"pp"} | ({"sp"} if sp_manual else set())
+        param_spec = jax.tree.map(lambda _: P("pp"), stage_layers)
+        mb_spec = P(None, None, "sp", None) if sp_manual else P()
+        pipe = jax.shard_map(
+            # TODO(pp+moe): the GPipe state is a single activation tensor, so
+            # the per-stage MoE aux loss is dropped under pipeline parallelism.
+            lambda sp_params, mb: gpipe_spmd(
+                lambda p, xx: stage_fn(p, xx)[0], sp_params, mb,
+                axis_name="pp"),
+            mesh=ctx.mesh, in_specs=(param_spec, mb_spec), out_specs=mb_spec,
+            axis_names=manual)
+        x = pipe(stage_layers, x_mb).reshape(B, *x.shape[1:])
+        aux = jnp.zeros((), jnp.float32)
+    elif sp_manual:
+        def _stack_pmean_aux(lp, xx):
+            y, aux = _stack_fwd(lp, xx, cos, sin, cfg, True)
+            return y, jax.lax.pmean(aux, "sp")
+
+        stack = jax.shard_map(
+            _stack_pmean_aux,
+            mesh=ctx.mesh,
+            in_specs=(jax.tree.map(lambda _: P(), params["layers"]),
+                      P(None, "sp", None)),
+            out_specs=(P(None, "sp", None), P()),
+            axis_names={"sp"})
+        x, aux = stack(params["layers"], x)
+    else:
+        x, aux = _stack_fwd(params["layers"], x, cos, sin, cfg, False)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(dt))
+    return logits.astype(jnp.float32), aux
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
+            ctx: Optional[ParallelContext] = None) -> jax.Array:
+    """tokens [B, S] -> logits [B, S, V] (float32)."""
+    return forward_with_aux(params, tokens, cfg, ctx)[0]
+
+
+def loss_fn(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
+            ctx: Optional[ParallelContext] = None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy (+ weighted MoE aux loss); targets = tokens
+    shifted left, last position masked."""
+    logits, aux = forward_with_aux(params, tokens, cfg, ctx)
+    targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+    mask = jnp.concatenate(
+        [jnp.ones(tokens[:, 1:].shape, jnp.float32),
+         jnp.zeros(tokens[:, :1].shape, jnp.float32)], axis=1)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    ce = (logz - gold) * mask
+    loss = jnp.sum(ce) / jnp.maximum(jnp.sum(mask), 1.0)
+    if cfg.n_experts > 0:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss, {"loss": loss, "tokens": jnp.sum(mask)}
+
+
+def flops_per_token(cfg: LlamaConfig, seq: int) -> float:
+    """Approximate training FLOPs/token (6N + attention term) for MFU."""
+    n = param_count(cfg) - cfg.vocab_size * cfg.d_model  # exclude embed lookup
+    attn = 12 * cfg.n_layers * cfg.d_model * seq  # 2*2*3 * L * D * S (fwd+bwd qk+av)
+    return 6.0 * n + attn

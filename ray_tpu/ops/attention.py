@@ -1,0 +1,256 @@
+"""Attention ops: reference softmax attention, Pallas TPU flash attention.
+
+The reference framework has no attention kernels of its own (it orchestrates
+engines like vLLM — reference: python/ray/llm/_internal/serve/deployments/llm/
+vllm/vllm_models.py); in the TPU-native rebuild the compute path is first-class,
+so the framework ships its own kernels.
+
+Design:
+  * ``attention_reference`` — pure jnp, fp32 softmax; ground truth for tests
+    and the CPU path.
+  * ``_flash_fwd_pallas`` — Pallas TPU forward kernel, online-softmax over KV
+    blocks with VMEM accumulators (MXU-aligned 128-multiple block shapes).
+  * ``flash_attention`` — custom_vjp: Pallas forward on TPU (reference forward
+    elsewhere); backward is a blockwise lax.scan at the XLA level using the
+    saved LSE, so the full [Sq, Skv] matrix is never materialized and every
+    inner op is an MXU matmul.
+
+Layout: [batch, num_heads, seq, head_dim] (BHSD).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; import guarded so CPU test envs can load this file.
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation
+# ---------------------------------------------------------------------------
+
+def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = True,
+                        sm_scale: Optional[float] = None,
+                        q_offset: int = 0,
+                        kv_offset: int = 0) -> jax.Array:
+    """Plain softmax attention with fp32 accumulation.
+
+    ``q_offset``/``kv_offset`` give the global positions of the local q/kv
+    shards — needed by ring attention where each sp shard sees rotated K/V.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[2])[:, None]
+        k_pos = kv_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *, sm_scale: float, causal: bool,
+                      block_q: int, block_k: int, kv_seq_len: int):
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _body():
+        q = q_ref[0]  # [block_q, d]
+        k = k_ref[0]  # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+
+    if causal:
+        # Skip fully-masked KV blocks (block above the diagonal).
+        pl.when(kv_idx * block_k <= q_idx * block_q + (block_q - 1))(_body)
+    else:
+        _body()
+
+    @pl.when(kv_idx == (kv_seq_len // block_k) - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[:] + jnp.log(l))[:, 0]
+
+
+def _flash_fwd_pallas(q, k, v, *, causal, sm_scale, block_q=256, block_k=256):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    assert sq % block_q == 0 and skv % block_k == 0, (sq, skv, block_q, block_k)
+    grid = (b * h, sq // block_q, skv // block_k)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, skv, d)
+    vr = v.reshape(b * h, skv, d)
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_seq_len=skv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            # lse kept 3-D [bh, 1, sq]: TPU needs the trailing two block dims
+            # tileable (1 == full middle dim, block_q % 128 == 0).
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _fwd_with_lse_reference(q, k, v, *, causal, sm_scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        q_pos = jnp.arange(q.shape[2])[:, None]
+        k_pos = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", (p / l).astype(v.dtype), v)
+    lse = (m + jnp.log(l))[..., 0]
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper with blockwise XLA backward
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_k_bwd: int = 512):
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale):
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    if _on_tpu() and q.shape[2] % 128 == 0 and k.shape[2] % 128 == 0 \
+            and q.shape[-1] % 128 == 0:
+        return _flash_fwd_pallas(q, k, v, causal=causal, sm_scale=scale)
+    return _fwd_with_lse_reference(q, k, v, causal=causal, sm_scale=scale)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_k_bwd):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_k_bwd, res, dout):
+    q, k, v, out, lse = res
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    skv = k.shape[2]
+    block = min(block_k_bwd, skv)
+    n_blocks = skv // block if skv % block == 0 else 1
+    if skv % block != 0:
+        block = skv
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # [b,h,sq]
+    q_pos = jnp.arange(q.shape[2])[:, None]
+
+    def kv_block(carry, idx):
+        dq_acc = carry
+        kb = jax.lax.dynamic_slice_in_dim(k, idx * block, block, axis=2)
+        vb = jax.lax.dynamic_slice_in_dim(v, idx * block, block, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kb,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = idx * block + jnp.arange(block)[None, :]
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse[..., None])  # [b,h,q,block]
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, dout.astype(jnp.float32))
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dout.astype(jnp.float32),
+                        vb.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                                     kb.astype(jnp.float32))
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+        return dq_acc, (dk, dv)
+
+    # (q * 0) rather than zeros: inherits q's varying-manual-axes type so the
+    # scan carry is consistent when this runs inside a shard_map (e.g. pp).
+    dq0 = (q * 0).astype(jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(kv_block, dq0, jnp.arange(n_blocks))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(k.shape)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(v.shape)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """Expand KV heads for grouped-query attention: [b, kvh, s, d] -> [b, kvh*n_rep, s, d]."""
+    if n_rep == 1:
+        return x
+    b, kvh, s, d = x.shape
+    return jnp.broadcast_to(x[:, :, None], (b, kvh, n_rep, s, d)).reshape(
+        b, kvh * n_rep, s, d)

@@ -1,0 +1,62 @@
+"""Mixture-of-experts routing and dispatch for the expert-parallel (``ep``)
+mesh axis.
+
+The reference framework only passes expert-parallel sizes through to vLLM
+(SURVEY.md §2.3 — EP row: "Not in Ray"); here MoE is a native layer. Round-1
+implementation uses dense one-hot dispatch (einsum against a one-hot combine
+tensor) — fully static shapes, MXU-friendly, correct under any sharding; the
+experts' weight leading axis carries the logical "expert" axis which the
+sharding rules map onto ``ep``. A ragged all-to-all Pallas dispatch is the
+planned optimization.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_routing(gate_logits: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """gate_logits: [tokens, n_experts] -> (weights [tokens, k], idx [tokens, k]).
+
+    Weights are softmaxed over the selected k (Mixtral-style).
+    """
+    vals, idx = jax.lax.top_k(gate_logits, k)
+    weights = jax.nn.softmax(vals.astype(jnp.float32), axis=-1)
+    return weights, idx
+
+
+def moe_ffn(x: jax.Array, gate_w: jax.Array, w_up: jax.Array, w_gate: jax.Array,
+            w_down: jax.Array, *, top_k: int = 2) -> Tuple[jax.Array, jax.Array]:
+    """SwiGLU MoE feed-forward with dense dispatch.
+
+    x: [tokens, d_model]
+    gate_w: [d_model, n_experts] router weights
+    w_up/w_gate: [n_experts, d_model, d_ff]; w_down: [n_experts, d_ff, d_model]
+    Returns (out [tokens, d_model], aux_loss scalar).
+    """
+    n_experts = gate_w.shape[-1]
+    logits = jnp.einsum("td,de->te", x, gate_w,
+                        preferred_element_type=jnp.float32)
+    weights, idx = top_k_routing(logits, top_k)
+    # combine[t, e] = routing weight of token t for expert e (0 if unselected)
+    one_hot = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)  # [t, k, e]
+    combine = jnp.einsum("tk,tke->te", weights, one_hot)
+
+    # Dense dispatch: every expert sees every token, masked by combine weight.
+    # Static shapes; the "expert" (leading) axis shards over ep so each device
+    # computes only its local experts and psums the combine below via GSPMD.
+    h_up = jnp.einsum("td,edf->etf", x, w_up)
+    h_gate = jnp.einsum("td,edf->etf", x, w_gate)
+    h = jax.nn.silu(h_gate) * h_up
+    expert_out = jnp.einsum("etf,efd->etd", h, w_down)
+    out = jnp.einsum("etd,te->td", expert_out.astype(jnp.float32), combine)
+
+    # Load-balancing aux loss (Switch-style): mean prob * mean assignment frac.
+    probs = jax.nn.softmax(logits, axis=-1)
+    frac_tokens = jnp.mean(one_hot.sum(axis=1), axis=0)  # [e]
+    frac_prob = jnp.mean(probs, axis=0)
+    aux = n_experts * jnp.sum(frac_tokens * frac_prob)
+    return out.astype(x.dtype), aux

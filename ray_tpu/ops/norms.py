@@ -1,0 +1,37 @@
+"""Normalization and rotary-embedding ops (pure jnp — XLA fuses these into
+adjacent matmuls on TPU; a Pallas version is only warranted if profiles show
+fusion misses)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [max_seq, head_dim//2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array | None = None) -> jax.Array:
+    """x: [b, h, s, d]; cos/sin: [max_seq, d//2]; positions: [s] global positions."""
+    s = x.shape[2]
+    if positions is None:
+        positions = jnp.arange(s)
+    c = cos[positions][None, None]  # [1,1,s,d//2]
+    si = sin[positions][None, None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * si, x1 * si + x2 * c], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,12 @@
+from ray_tpu.parallel.mesh import AXIS_NAMES, MeshConfig, build_mesh, single_device_mesh
+from ray_tpu.parallel.sharding import (DEFAULT_RULES, batch_spec, shard_batch,
+                                       sharding_for, spec_for, tree_shardings,
+                                       tree_specs)
+from ray_tpu.parallel.context import ParallelContext
+from ray_tpu.parallel.pipeline import gpipe_spmd
+
+__all__ = [
+    "AXIS_NAMES", "MeshConfig", "build_mesh", "single_device_mesh",
+    "DEFAULT_RULES", "batch_spec", "shard_batch", "sharding_for", "spec_for",
+    "tree_shardings", "tree_specs", "ParallelContext", "gpipe_spmd",
+]

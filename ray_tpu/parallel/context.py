@@ -1,0 +1,47 @@
+"""ParallelContext: mesh + mesh-config + sharding rules bundle threaded through
+model/train code (the TPU-native analogue of the reference Train worker's
+process-group context, reference: python/ray/train/torch/config.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+from ray_tpu.parallel.sharding import DEFAULT_RULES, MeshAxes, batch_spec
+
+
+@dataclasses.dataclass
+class ParallelContext:
+    mesh: Mesh
+    config: MeshConfig
+    rules: Dict[str, MeshAxes] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_RULES))
+
+    @staticmethod
+    def create(config: Optional[MeshConfig] = None, devices=None) -> "ParallelContext":
+        if config is None:
+            n = len(devices) if devices is not None else len(jax.devices())
+            config = MeshConfig.for_devices(n)
+        return ParallelContext(build_mesh(config, devices), config)
+
+    @property
+    def sp(self) -> int:
+        return self.config.sp
+
+    @property
+    def pp(self) -> int:
+        return self.config.pp
+
+    @property
+    def ep(self) -> int:
+        return self.config.ep
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, batch_spec())
+
+    def activation_spec(self) -> P:
+        return P(*batch_spec(), None)

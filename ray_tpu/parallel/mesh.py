@@ -1,0 +1,84 @@
+"""Device-mesh construction for ray_tpu.
+
+TPU-first replacement for the reference's process-group world (torch DDP/NCCL
+groups created by Ray Train, reference: python/ray/train/torch/config.py and
+python/ray/util/collective/collective.py:166). Instead of rank-indexed process
+groups, parallelism is expressed as named axes of a `jax.sharding.Mesh`;
+XLA/GSPMD inserts the collectives over ICI/DCN.
+
+Axis vocabulary (all six are always present; unused axes have size 1):
+
+  pp   pipeline parallel — p2p activation transfer, lowest bandwidth need,
+       outermost (maps to DCN across slices in multi-slice deployments)
+  dp   pure data parallel — gradient allreduce per step
+  fsdp sharded data parallel (ZeRO-3/GSPMD param sharding) — allgather/reducescatter
+  ep   expert parallel — all-to-all dispatch for MoE layers
+  sp   sequence/context parallel — ring attention K/V rotation (ppermute)
+  tp   tensor parallel — per-layer allreduce, highest bandwidth, innermost so it
+       lands on the tightest ICI ring
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_NAMES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+# Axes over which the global batch is split.
+BATCH_AXES = ("dp", "fsdp")
+# Axes over which model parameters are sharded (fsdp dimension-sharding + tp).
+PARAM_AXES = ("fsdp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.pp, self.dp, self.fsdp, self.ep, self.sp, self.tp)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.shape)
+
+    def with_axes(self, **kw) -> "MeshConfig":
+        return dataclasses.replace(self, **kw)
+
+    @staticmethod
+    def for_devices(n: int) -> "MeshConfig":
+        """Reasonable default factorization: all-FSDP (ZeRO-style) over n chips."""
+        return MeshConfig(fsdp=n)
+
+
+def build_mesh(config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = config.num_devices
+    if n > len(devices):
+        raise ValueError(
+            f"MeshConfig {config} needs {n} devices but only {len(devices)} available")
+    devices = list(devices)[:n]
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            config.shape, devices=devices, allow_split_physical_axes=True)
+    except Exception:
+        dev_array = np.array(devices).reshape(config.shape)
+    return Mesh(dev_array, AXIS_NAMES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    device = device or jax.devices()[0]
+    return Mesh(np.array([device]).reshape((1,) * len(AXIS_NAMES)), AXIS_NAMES)

@@ -1,0 +1,3 @@
+from ray_tpu.train.spmd import default_optimizer, make_train_fns, state_shardings
+
+__all__ = ["default_optimizer", "make_train_fns", "state_shardings"]

@@ -1,0 +1,4 @@
+from ray_tpu.utils.config import GlobalConfig
+from ray_tpu.utils.logging import get_logger
+
+__all__ = ["GlobalConfig", "get_logger"]

@@ -1,0 +1,158 @@
+"""Flag/config system for ray_tpu.
+
+TPU-native analogue of the reference's X-macro ``RAY_CONFIG`` system
+(reference: src/ray/common/ray_config_def.h — 223 flags, each overridable via a
+``RAY_<name>`` env var) and the Python-side constants
+(python/ray/_private/ray_constants.py).
+
+Here a single declarative registry defines every flag with a type and default;
+every flag is overridable via ``RAY_TPU_<NAME>`` environment variables, and a
+serialized config dict can be passed down to spawned node processes (the
+reference passes ``--config-list`` at process spawn; we pass a JSON blob).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(v: str) -> bool:
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: type
+    default: Any
+    doc: str = ""
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _flag(name: str, typ: type, default: Any, doc: str = "") -> None:
+    _REGISTRY[name] = _Flag(name, typ, default, doc)
+
+
+# ---------------------------------------------------------------------------
+# Flag definitions (mirrors the spirit of ray_config_def.h; grouped by area).
+# ---------------------------------------------------------------------------
+
+# --- core timeouts / intervals ---
+_flag("health_check_period_ms", int, 1000, "Controller->node health-check period.")
+_flag("health_check_timeout_ms", int, 10000, "Mark node dead after this long without a heartbeat.")
+_flag("resource_broadcast_period_ms", int, 100, "Node resource gossip period.")
+_flag("handler_warning_timeout_ms", int, 1000, "Warn on event-loop handlers slower than this.")
+_flag("worker_register_timeout_s", int, 30, "Worker must register with its node agent within this.")
+_flag("task_retry_delay_ms", int, 100, "Delay before retrying a failed task.")
+
+# --- object store ---
+_flag("object_store_memory_bytes", int, 2 * 1024**3, "Default shm arena size per node.")
+_flag("object_store_min_spill_bytes", int, 100 * 1024**2, "Batch spills until this many bytes.")
+_flag("max_direct_call_object_size", int, 100 * 1024, "Inline results smaller than this in-process.")
+_flag("object_transfer_chunk_bytes", int, 5 * 1024**2, "Chunk size for node-to-node object transfer.")
+_flag("object_spill_dir", str, "", "Directory for spilled objects (default: session dir).")
+
+# --- scheduling ---
+_flag("scheduler_spread_threshold", float, 0.5, "Hybrid policy: pack below this utilization, then spread.")
+_flag("max_pending_lease_requests_per_class", int, 10, "Pipelined lease requests per scheduling class.")
+_flag("worker_pool_max_idle_workers", int, 8, "Idle workers kept warm per node.")
+_flag("worker_pool_idle_ttl_s", int, 300, "Kill idle workers after this long.")
+
+# --- fault tolerance ---
+_flag("max_task_retries_default", int, 3, "Default retries for retriable tasks.")
+_flag("actor_max_restarts_default", int, 0, "Default actor restarts.")
+_flag("lineage_pinning_enabled", bool, True, "Pin lineage for object reconstruction.")
+
+# --- chaos / testing (reference: src/ray/rpc/rpc_chaos.cc, RAY_testing_rpc_failure) ---
+_flag("testing_rpc_failure", str, "", "Comma list 'method=prob' to randomly fail RPCs.")
+_flag("testing_event_loop_delay_us", int, 0, "Inject delay into event-loop handlers (asio-delay analogue).")
+
+# --- TPU / accelerator plane ---
+_flag("tpu_chips_per_host", int, 4, "Fallback chip count when discovery unavailable.")
+_flag("tpu_visible_chips", str, "", "Analogue of TPU_VISIBLE_CHIPS pinning.")
+_flag("collective_cpu_fallback", bool, True, "Allow CPU fallback collectives when no TPU present.")
+
+# --- logging / observability ---
+_flag("event_stats_enabled", bool, True, "Record per-handler event-loop stats.")
+_flag("task_events_batch_size", int, 1000, "Task events per batch sent to controller.")
+_flag("metrics_report_period_ms", int, 5000, "Metrics push period.")
+
+
+class Config:
+    """Process-global config singleton (thread-safe lazy resolution).
+
+    Resolution order: explicit overrides (``initialize``) > ``RAY_TPU_*`` env
+    var > registered default.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._overrides: Dict[str, Any] = {}
+        self._cache: Dict[str, Any] = {}
+
+    def initialize(self, overrides: Dict[str, Any] | None = None) -> None:
+        with self._lock:
+            if overrides:
+                unknown = set(overrides) - set(_REGISTRY)
+                if unknown:
+                    raise ValueError(f"Unknown config flags: {sorted(unknown)}")
+                self._overrides.update(overrides)
+            self._cache.clear()
+
+    def get(self, name: str) -> Any:
+        try:
+            return self._cache[name]
+        except KeyError:
+            pass
+        flag = _REGISTRY[name]
+        with self._lock:
+            if name in self._overrides:
+                val = self._overrides[name]
+            else:
+                env = os.environ.get(_ENV_PREFIX + name.upper())
+                val = _PARSERS[flag.type](env) if env is not None else flag.default
+            self._cache[name] = val
+            return val
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in _REGISTRY:
+            raise AttributeError(f"No such config flag: {name}")
+        return self.get(name)
+
+    # --- serialization for spawned processes ---
+    def serialize(self) -> str:
+        with self._lock:
+            return json.dumps(self._overrides)
+
+    @staticmethod
+    def deserialize_into_env(blob: str) -> Dict[str, str]:
+        """Return env-var dict encoding the overrides for a child process."""
+        overrides = json.loads(blob) if blob else {}
+        return {
+            _ENV_PREFIX + k.upper(): str(int(v) if isinstance(v, bool) else v)
+            for k, v in overrides.items()
+        }
+
+    def all_flags(self) -> Dict[str, Any]:
+        return {name: self.get(name) for name in _REGISTRY}
+
+
+GlobalConfig = Config()

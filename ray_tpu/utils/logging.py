@@ -1,0 +1,42 @@
+"""Structured per-process logging (analogue of reference src/ray/util logging +
+python/ray/_private/ray_logging). Each process logs to stderr and, when a
+session directory is configured, to ``<session>/logs/<component>-<pid>.log``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname).1s %(process)d %(name)s] %(message)s"
+_configured = False
+_file_handlers: set[str] = set()
+
+
+def configure(component: str = "driver", session_dir: str | None = None,
+              level: int = logging.INFO) -> logging.Logger:
+    global _configured
+    root = logging.getLogger("ray_tpu")
+    if not _configured:
+        root.setLevel(level)
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(_FORMAT))
+        root.addHandler(h)
+        root.propagate = False
+        _configured = True
+    if session_dir:
+        log_dir = os.path.join(session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        path = os.path.join(log_dir, f"{component}-{os.getpid()}.log")
+        if path not in _file_handlers:  # one handler per file, ever
+            _file_handlers.add(path)
+            fh = logging.FileHandler(path)
+            fh.setFormatter(logging.Formatter(_FORMAT))
+            root.addHandler(fh)
+    return root
+
+
+def get_logger(name: str) -> logging.Logger:
+    configure()
+    return logging.getLogger(f"ray_tpu.{name}")

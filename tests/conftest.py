@@ -1,0 +1,36 @@
+"""Test harness conftest.
+
+Tests run on a virtual 8-device CPU mesh (the reference's analogue is
+cluster_utils.Cluster simulating many nodes in one box — reference:
+python/ray/cluster_utils.py:135; for SPMD code the CPU-device trick replaces
+real chips, per SURVEY.md §4 implication (c)).
+
+The container's sitecustomize may register a TPU PJRT plugin at interpreter
+start; we switch JAX to the CPU platform in-process (config update + backend
+reset) before any test imports jax.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax.extend.backend as _jb
+    _jb.clear_backends()
+except Exception:  # pragma: no cover
+    pass
+
+assert jax.default_backend() == "cpu", jax.default_backend()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, devs
+    return devs

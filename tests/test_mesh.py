@@ -1,0 +1,51 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel import (AXIS_NAMES, MeshConfig, build_mesh, spec_for,
+                              tree_specs)
+from ray_tpu.parallel.sharding import DEFAULT_RULES
+from ray_tpu.utils.config import GlobalConfig
+
+
+def test_mesh_axis_names(devices8):
+    mesh = build_mesh(MeshConfig(dp=2, tp=4))
+    assert mesh.axis_names == AXIS_NAMES
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+
+
+def test_mesh_too_many_devices(devices8):
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(dp=16))
+
+
+def test_for_devices_default():
+    cfg = MeshConfig.for_devices(8)
+    assert cfg.num_devices == 8 and cfg.fsdp == 8
+
+
+def test_spec_for_rules():
+    assert spec_for(("embed", "heads")) == P("fsdp", "tp")
+    assert spec_for((None, "expert")) == P(None, "ep")
+    assert spec_for(("layers", "embed")) == P(None, "fsdp")
+
+
+def test_tree_specs():
+    tree = {"a": ("embed", "mlp"), "b": {"c": ("vocab", "embed")}}
+    specs = tree_specs(tree)
+    assert specs["a"] == P("fsdp", "tp")
+    assert specs["b"]["c"] == P("tp", "fsdp")
+
+
+def test_config_env_override(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SCHEDULER_SPREAD_THRESHOLD", "0.75")
+    from ray_tpu.utils.config import Config
+    c = Config()
+    assert c.scheduler_spread_threshold == 0.75
+    assert c.health_check_period_ms == 1000
+
+
+def test_config_unknown_flag():
+    with pytest.raises(AttributeError):
+        GlobalConfig.no_such_flag
