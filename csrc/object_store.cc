@@ -1,0 +1,282 @@
+// Shared-memory object store engine for ray_tpu node agents.
+//
+// TPU-native analogue of the reference's plasma store (reference:
+// src/ray/object_manager/plasma/{store.cc,object_store.cc,obj_lifecycle_mgr.cc,
+// plasma_allocator.cc,eviction_policy.cc}). Same role — node-local immutable
+// shared-memory objects with zero-copy reads, refcount pinning, LRU eviction
+// of unpinned sealed objects — but a different shape: instead of one dlmalloc
+// arena behind a custom fd-passing socket protocol, every object is its own
+// tmpfs-backed file under a session directory that clients mmap directly
+// (control traffic rides the agent's RPC; the kernel page cache is the arena).
+// This keeps the native engine focused on lifecycle/accounting/eviction and
+// makes host<->TPU DMA staging a plain mmap.
+//
+// Built as libraytpu_store.so, driven in-process by the node agent via ctypes.
+//
+// Thread-safe: a single mutex guards the index (operations are O(1)-ish and
+// the data path never holds it — clients write/read through their own mmaps).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <list>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int kIdSize = 20;
+
+struct ObjectEntry {
+  std::string path;
+  uint64_t data_size = 0;
+  uint64_t meta_size = 0;
+  bool sealed = false;
+  bool pinned = false;          // primary copy: never evict
+  bool pending_delete = false;  // delete once refcount drops to 0
+  int64_t refcount = 0;
+  // LRU bookkeeping: valid iff evictable (sealed, refcount==0, !pinned).
+  std::list<std::string>::iterator lru_it;
+  bool in_lru = false;
+};
+
+struct Store {
+  std::string dir;
+  uint64_t capacity = 0;
+  uint64_t used = 0;
+  uint64_t num_evictions = 0;
+  uint64_t bytes_evicted = 0;
+  std::mutex mu;
+  std::unordered_map<std::string, ObjectEntry> objects;
+  std::list<std::string> lru;  // front = oldest
+};
+
+std::string IdKey(const char* id) { return std::string(id, kIdSize); }
+
+std::string HexPath(const Store& s, const std::string& key) {
+  static const char* hexd = "0123456789abcdef";
+  std::string hex;
+  hex.reserve(kIdSize * 2);
+  for (unsigned char c : key) {
+    hex.push_back(hexd[c >> 4]);
+    hex.push_back(hexd[c & 0xf]);
+  }
+  return s.dir + "/" + hex;
+}
+
+void LruPush(Store* s, const std::string& key, ObjectEntry* e) {
+  s->lru.push_back(key);
+  e->lru_it = std::prev(s->lru.end());
+  e->in_lru = true;
+}
+
+void LruRemove(Store* s, ObjectEntry* e) {
+  if (e->in_lru) {
+    s->lru.erase(e->lru_it);
+    e->in_lru = false;
+  }
+}
+
+// Caller holds mu. Removes entry + backing file.
+void EraseObject(Store* s, const std::string& key) {
+  auto it = s->objects.find(key);
+  if (it == s->objects.end()) return;
+  LruRemove(s, &it->second);
+  s->used -= it->second.data_size + it->second.meta_size;
+  ::unlink(it->second.path.c_str());
+  s->objects.erase(it);
+}
+
+// Caller holds mu. Evict LRU victims until `needed` bytes fit. Returns true
+// if enough space was freed.
+bool EvictFor(Store* s, uint64_t needed) {
+  while (s->used + needed > s->capacity && !s->lru.empty()) {
+    std::string victim = s->lru.front();
+    auto it = s->objects.find(victim);
+    // lru entries are kept consistent; still guard against staleness.
+    if (it == s->objects.end()) {
+      s->lru.pop_front();
+      continue;
+    }
+    s->num_evictions++;
+    s->bytes_evicted += it->second.data_size + it->second.meta_size;
+    EraseObject(s, victim);
+  }
+  return s->used + needed <= s->capacity;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* store_create(const char* dir, uint64_t capacity) {
+  auto* s = new Store();
+  s->dir = dir;
+  s->capacity = capacity;
+  ::mkdir(dir, 0700);
+  return s;
+}
+
+void store_destroy(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    for (auto& kv : s->objects) ::unlink(kv.second.path.c_str());
+  }
+  ::rmdir(s->dir.c_str());
+  delete s;
+}
+
+// 0 ok, -1 already exists, -2 out of memory (after eviction), -3 io error.
+int store_create_object(void* handle, const char* id, uint64_t data_size,
+                        uint64_t meta_size, char* out_path, int path_cap) {
+  auto* s = static_cast<Store*>(handle);
+  std::string key = IdKey(id);
+  uint64_t total = data_size + meta_size;
+  std::string path;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (s->objects.count(key)) return -1;
+    if (total > s->capacity) return -2;
+    if (!EvictFor(s, total)) return -2;
+    path = HexPath(*s, key);
+    ObjectEntry e;
+    e.path = path;
+    e.data_size = data_size;
+    e.meta_size = meta_size;
+    s->used += total;
+    s->objects.emplace(key, std::move(e));
+  }
+  int fd = ::open(path.c_str(), O_CREAT | O_RDWR | O_EXCL, 0600);
+  if (fd < 0) {
+    std::lock_guard<std::mutex> g(s->mu);
+    EraseObject(s, key);
+    return -3;
+  }
+  if (total > 0 && ::ftruncate(fd, (off_t)total) != 0) {
+    ::close(fd);
+    std::lock_guard<std::mutex> g(s->mu);
+    EraseObject(s, key);
+    return -3;
+  }
+  ::close(fd);
+  std::snprintf(out_path, path_cap, "%s", path.c_str());
+  return 0;
+}
+
+// 0 ok, -1 missing.
+int store_seal(void* handle, const char* id) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->objects.find(IdKey(id));
+  if (it == s->objects.end()) return -1;
+  ObjectEntry& e = it->second;
+  e.sealed = true;
+  if (e.refcount == 0 && !e.pinned && !e.in_lru) LruPush(s, it->first, &e);
+  return 0;
+}
+
+// Pins the object (refcount++). 0 ok, -1 missing, -2 unsealed.
+int store_get(void* handle, const char* id, char* out_path, int path_cap,
+              uint64_t* data_size, uint64_t* meta_size) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->objects.find(IdKey(id));
+  if (it == s->objects.end()) return -1;
+  ObjectEntry& e = it->second;
+  if (!e.sealed) return -2;
+  e.refcount++;
+  LruRemove(s, &e);
+  std::snprintf(out_path, path_cap, "%s", e.path.c_str());
+  *data_size = e.data_size;
+  *meta_size = e.meta_size;
+  return 0;
+}
+
+// 0 ok, -1 missing.
+int store_release(void* handle, const char* id) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string key = IdKey(id);
+  auto it = s->objects.find(key);
+  if (it == s->objects.end()) return -1;
+  ObjectEntry& e = it->second;
+  if (e.refcount > 0) e.refcount--;
+  if (e.refcount == 0) {
+    if (e.pending_delete) {
+      EraseObject(s, key);
+    } else if (e.sealed && !e.pinned && !e.in_lru) {
+      LruPush(s, key, &e);
+    }
+  }
+  return 0;
+}
+
+// Deletes now if unreferenced, else marks pending-delete. 0 ok, -1 missing.
+int store_delete(void* handle, const char* id) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string key = IdKey(id);
+  auto it = s->objects.find(key);
+  if (it == s->objects.end()) return -1;
+  if (it->second.refcount == 0) {
+    EraseObject(s, key);
+  } else {
+    it->second.pending_delete = true;
+  }
+  return 0;
+}
+
+// 1 sealed-present, 0 absent, 2 present-unsealed.
+int store_contains(void* handle, const char* id) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->objects.find(IdKey(id));
+  if (it == s->objects.end()) return 0;
+  return it->second.sealed ? 1 : 2;
+}
+
+// Pin/unpin primary copies (exempt from eviction; spill candidates).
+int store_pin(void* handle, const char* id, int pinned) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->objects.find(IdKey(id));
+  if (it == s->objects.end()) return -1;
+  ObjectEntry& e = it->second;
+  e.pinned = pinned != 0;
+  if (e.pinned) {
+    LruRemove(s, &e);
+  } else if (e.sealed && e.refcount == 0 && !e.in_lru) {
+    LruPush(s, it->first, &e);
+  }
+  return 0;
+}
+
+uint64_t store_used(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->used;
+}
+
+uint64_t store_capacity(void* handle) {
+  return static_cast<Store*>(handle)->capacity;
+}
+
+uint64_t store_num_objects(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->objects.size();
+}
+
+uint64_t store_num_evictions(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->num_evictions;
+}
+
+}  // extern "C"

@@ -7,4 +7,23 @@ JAX/XLA/Pallas/pjit over TPU ICI/DCN. See SURVEY.md for the blueprint.
 
 from ray_tpu.version import __version__
 
-__all__ = ["__version__"]
+_API_NAMES = (
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "get_actor", "placement_group", "remove_placement_group",
+    "PlacementGroup", "nodes", "cluster_resources", "available_resources",
+    "ObjectRef", "ActorHandle",
+)
+
+
+def __getattr__(name):
+    # Lazy: importing ray_tpu stays light; the runtime loads on first API use.
+    if name in _API_NAMES:
+        if name in ("ObjectRef", "ActorHandle"):
+            from ray_tpu.core import ref as _ref
+            return getattr(_ref, name)
+        from ray_tpu import api as _api
+        return getattr(_api, name)
+    raise AttributeError(name)
+
+
+__all__ = ["__version__", *_API_NAMES]

@@ -1,0 +1,145 @@
+"""Common runtime datatypes: task specs, addresses, errors, resources.
+
+Analogue of the reference's src/ray/common/ (TaskSpecification in
+task/task_spec.cc, Status model in status.h, scheduling resource sets in
+scheduling/resource_set.cc) — flattened to the pieces the TPU-native runtime
+needs, in pickle-friendly dataclasses (the wire format is the RPC layer's
+pickle; protobuf's role as cross-language schema is a non-goal for v1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+Address = Tuple[str, int]  # (host, port) of an RPC server
+
+# --- resources -------------------------------------------------------------
+
+CPU = "CPU"
+TPU = "TPU"  # one unit per chip (the reference bolts this on via
+#              python/ray/_private/accelerators/tpu.py; here it is native)
+MEMORY = "memory"
+
+
+def resources_fit(avail: Dict[str, float], need: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items() if v > 0)
+
+
+def resources_sub(avail: Dict[str, float], need: Dict[str, float]) -> None:
+    for k, v in need.items():
+        if v:
+            avail[k] = avail.get(k, 0.0) - v
+
+
+def resources_add(avail: Dict[str, float], need: Dict[str, float]) -> None:
+    for k, v in need.items():
+        if v:
+            avail[k] = avail.get(k, 0.0) + v
+
+
+# --- task spec -------------------------------------------------------------
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: bytes
+    name: str
+    func_id: bytes                     # key into the controller function table
+    args: List[Any]                    # ("v", data, meta) | ("r", oid, owner_addr)
+    num_returns: int
+    resources: Dict[str, float]
+    owner_addr: Address
+    owner_worker_id: bytes
+    job_id: bytes = b"\x00" * 4
+    # actor fields
+    actor_id: Optional[bytes] = None           # target actor for method calls
+    actor_creation: Optional[dict] = None      # creation spec (max_restarts...)
+    method_name: str = ""
+    seqno: int = 0                             # per-caller ordering
+    caller_id: bytes = b""
+    # fault tolerance
+    max_retries: int = 0
+    retry_count: int = 0
+    # placement
+    placement_group: Optional[bytes] = None
+    pg_bundle_index: int = -1
+    scheduling_strategy: Optional[Any] = None  # e.g. NodeAffinity
+    runtime_env: Optional[dict] = None
+
+    @property
+    def is_actor_creation(self) -> bool:
+        return self.actor_creation is not None
+
+    @property
+    def is_actor_task(self) -> bool:
+        return self.actor_id is not None and self.actor_creation is None
+
+    def scheduling_class(self) -> tuple:
+        return (self.func_id, tuple(sorted(self.resources.items())))
+
+
+# --- errors ----------------------------------------------------------------
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """A task raised; carries the remote traceback. Re-raised at ray.get."""
+
+    def __init__(self, cause_repr: str, traceback_str: str = ""):
+        super().__init__(cause_repr)
+        self.cause_repr = cause_repr
+        self.traceback_str = traceback_str
+
+    def __str__(self):
+        return f"{self.cause_repr}\n\nRemote traceback:\n{self.traceback_str}"
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass
+
+
+# --- lifecycle states ------------------------------------------------------
+
+class ActorState:
+    PENDING = "PENDING"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+class NodeState:
+    ALIVE = "ALIVE"
+    DEAD = "DEAD"
+
+
+class PGState:
+    PENDING = "PENDING"
+    CREATED = "CREATED"
+    REMOVED = "REMOVED"
+
+
+def now() -> float:
+    return time.monotonic()

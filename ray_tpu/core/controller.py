@@ -1,0 +1,538 @@
+"""Controller — the head-node control plane (GCS equivalent).
+
+Analogue of the reference's GCS server (reference: src/ray/gcs/gcs_server.cc
+and its managers: gcs_node_manager.cc, gcs_actor_manager.cc +
+gcs_actor_scheduler.cc, gcs_placement_group_manager.cc /
+gcs_placement_group_scheduler.cc 2-phase commit, gcs_kv_manager.cc,
+gcs_job_manager.cc, gcs_health_check_manager.cc). One asyncio process holding
+cluster metadata:
+
+  * node table + liveness (heartbeat timeout -> DEAD, broadcast to agents)
+  * actor lifecycle FSM (PENDING -> ALIVE -> RESTARTING -> DEAD with
+    max_restarts), actor scheduling onto node agents, named actors
+  * placement groups with 2-phase prepare/commit bundle reservation
+  * namespaced KV store (function table lives in ns="fn")
+  * cluster resource view + hybrid node-picking policy for lease spillback
+
+State is in-memory (the reference's default store_client is also in-memory;
+Redis-backed persistence is the fault-tolerance extension point).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.common import (ActorState, Address, NodeState, PGState,
+                                 resources_add, resources_fit, resources_sub)
+from ray_tpu.core.rpc import RpcClient, RpcServer
+from ray_tpu.utils import get_logger
+from ray_tpu.utils.config import GlobalConfig
+
+logger = get_logger("controller")
+
+
+class NodeEntry:
+    def __init__(self, node_id: bytes, addr: Address,
+                 resources: Dict[str, float], labels: Dict[str, str]):
+        self.node_id = node_id
+        self.addr = addr
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.labels = labels
+        self.state = NodeState.ALIVE
+        self.last_heartbeat = time.monotonic()
+        self.client = RpcClient(addr)
+
+
+class ActorEntry:
+    def __init__(self, actor_id: bytes, spec_blob: bytes, name: str,
+                 max_restarts: int, resources: Dict[str, float],
+                 placement: Optional[Tuple[bytes, int]]):
+        self.actor_id = actor_id
+        self.spec_blob = spec_blob
+        self.name = name
+        self.max_restarts = max_restarts
+        self.restarts_used = 0
+        self.resources = resources
+        self.placement = placement
+        self.state = ActorState.PENDING
+        self.addr: Optional[Address] = None
+        self.node_id: Optional[bytes] = None
+        self.death_reason = ""
+        self.event = asyncio.Event()  # set on ALIVE or DEAD transitions
+
+
+class PGEntry:
+    def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]],
+                 strategy: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.state = PGState.PENDING
+        self.bundle_nodes: List[Optional[bytes]] = [None] * len(bundles)
+        self.event = asyncio.Event()
+
+
+class Controller:
+    def __init__(self):
+        self.nodes: Dict[bytes, NodeEntry] = {}
+        self.actors: Dict[bytes, ActorEntry] = {}
+        self.named_actors: Dict[str, bytes] = {}
+        self.pgs: Dict[bytes, PGEntry] = {}
+        self.kv: Dict[str, Dict[str, bytes]] = {}
+        self.jobs: Dict[bytes, dict] = {}
+        self._next_job = 1
+        self._health_task: Optional[asyncio.Task] = None
+        self._node_seq = 0  # round-robin cursor for SPREAD
+
+    # ------------------------------------------------------------------
+    # node management
+    # ------------------------------------------------------------------
+    async def register_node(self, node_id: bytes, addr, resources: dict,
+                            labels: dict) -> dict:
+        addr = tuple(addr)
+        self.nodes[node_id] = NodeEntry(node_id, addr, resources, labels)
+        logger.info("node registered %s addr=%s resources=%s",
+                    node_id.hex()[:8], addr, resources)
+        return {"num_nodes": len(self.nodes)}
+
+    async def heartbeat(self, node_id: bytes, resources_available: dict) -> bool:
+        node = self.nodes.get(node_id)
+        if node is None or node.state == NodeState.DEAD:
+            return False  # tells a zombie agent to shut down
+        node.last_heartbeat = time.monotonic()
+        node.resources_available = resources_available
+        return True
+
+    async def get_nodes(self) -> list:
+        return [{
+            "node_id": n.node_id, "addr": n.addr, "state": n.state,
+            "resources_total": n.resources_total,
+            "resources_available": n.resources_available,
+            "labels": n.labels,
+        } for n in self.nodes.values()]
+
+    async def drain_node(self, node_id: bytes) -> None:
+        await self._mark_node_dead(node_id, "drained")
+
+    async def _mark_node_dead(self, node_id: bytes, reason: str) -> None:
+        node = self.nodes.get(node_id)
+        if node is None or node.state == NodeState.DEAD:
+            return
+        node.state = NodeState.DEAD
+        logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        # Actors on the node die (and maybe restart).
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (
+                    ActorState.ALIVE, ActorState.PENDING):
+                asyncio.ensure_future(self._handle_actor_failure(
+                    actor, f"node died: {reason}"))
+        # Broadcast to remaining agents (object copies on that node are gone).
+        for other in self.nodes.values():
+            if other.state == NodeState.ALIVE:
+                asyncio.ensure_future(self._notify(
+                    other, "node_dead", node_id))
+
+    async def _notify(self, node: NodeEntry, method: str, *args) -> None:
+        try:
+            await node.client.call(method, *args)
+        except Exception as e:
+            logger.debug("notify %s to %s failed: %r", method,
+                         node.node_id.hex()[:8], e)
+
+    async def _health_loop(self) -> None:
+        period = GlobalConfig.health_check_period_ms / 1000
+        timeout = GlobalConfig.health_check_timeout_ms / 1000
+        while True:
+            await asyncio.sleep(period)
+            cutoff = time.monotonic() - timeout
+            for node in list(self.nodes.values()):
+                if node.state == NodeState.ALIVE and node.last_heartbeat < cutoff:
+                    await self._mark_node_dead(node.node_id,
+                                               "health check timeout")
+
+    # ------------------------------------------------------------------
+    # scheduling policy (hybrid pack-then-spread, reference:
+    # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc)
+    # ------------------------------------------------------------------
+    def _alive_nodes(self) -> List[NodeEntry]:
+        return [n for n in self.nodes.values() if n.state == NodeState.ALIVE]
+
+    def _pick(self, resources: Dict[str, float],
+              exclude: Optional[set] = None,
+              strategy: Optional[Any] = None) -> Optional[NodeEntry]:
+        nodes = [n for n in self._alive_nodes()
+                 if not exclude or n.node_id not in exclude]
+        if strategy is not None:
+            kind = strategy.get("kind") if isinstance(strategy, dict) else None
+            if kind == "node_affinity":
+                target = strategy["node_id"]
+                for n in nodes:
+                    if n.node_id == target:
+                        if resources_fit(n.resources_available, resources) or \
+                                strategy.get("soft"):
+                            return n
+                return None if not strategy.get("soft") else (
+                    self._pick(resources, exclude, None))
+            if kind == "spread":
+                fitting = [n for n in nodes
+                           if resources_fit(n.resources_available, resources)]
+                if not fitting:
+                    return None
+                self._node_seq += 1
+                return fitting[self._node_seq % len(fitting)]
+        threshold = GlobalConfig.scheduler_spread_threshold
+        fitting = [n for n in nodes
+                   if resources_fit(n.resources_available, resources)]
+        if not fitting:
+            return None
+
+        def utilization(n: NodeEntry) -> float:
+            utils = []
+            for k, total in n.resources_total.items():
+                if total > 0:
+                    utils.append(1 - n.resources_available.get(k, 0) / total)
+            return max(utils) if utils else 0.0
+
+        below = [n for n in fitting if utilization(n) < threshold]
+        pool = below or fitting
+        # Pack: highest utilization first among below-threshold nodes.
+        return max(pool, key=utilization)
+
+    async def pick_node(self, resources: dict, exclude=None,
+                        strategy=None) -> Optional[dict]:
+        exclude = set(exclude) if exclude else None
+        node = self._pick(resources, exclude, strategy)
+        if node is None:
+            return None
+        return {"node_id": node.node_id, "addr": node.addr}
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    async def create_actor(self, actor_id: bytes, spec_blob: bytes, name: str,
+                           max_restarts: int, resources: dict,
+                           placement=None, detached: bool = False) -> dict:
+        if name:
+            if name in self.named_actors:
+                raise ValueError(f"actor name already taken: {name!r}")
+            self.named_actors[name] = actor_id
+        entry = ActorEntry(actor_id, spec_blob, name, max_restarts, resources,
+                           tuple(placement) if placement else None)
+        self.actors[actor_id] = entry
+        asyncio.ensure_future(self._schedule_actor(entry))
+        return {"actor_id": actor_id}
+
+    async def _schedule_actor(self, entry: ActorEntry) -> None:
+        # Placement-group bundle affinity pins the target node.
+        target: Optional[NodeEntry] = None
+        if entry.placement:
+            pg = self.pgs.get(entry.placement[0])
+            if pg and pg.state == PGState.CREATED:
+                node_id = pg.bundle_nodes[entry.placement[1]]
+                target = self.nodes.get(node_id)
+        attempts = 0
+        while attempts < 60:
+            node = target or self._pick(entry.resources)
+            if node is not None:
+                try:
+                    reply = await node.client.call(
+                        "start_actor", entry.actor_id, entry.spec_blob,
+                        entry.resources,
+                        entry.placement[0] if entry.placement else None,
+                        entry.placement[1] if entry.placement else -1)
+                    entry.addr = tuple(reply["addr"])
+                    entry.node_id = node.node_id
+                    entry.state = ActorState.ALIVE
+                    entry.event.set()
+                    return
+                except Exception as e:
+                    logger.warning("actor %s failed to start on %s: %r",
+                                   entry.actor_id.hex()[:8],
+                                   node.node_id.hex()[:8], e)
+            attempts += 1
+            await asyncio.sleep(0.2)
+        entry.state = ActorState.DEAD
+        entry.death_reason = "could not schedule actor (no feasible node)"
+        entry.event.set()
+
+    async def report_actor_death(self, actor_id: bytes, reason: str) -> None:
+        entry = self.actors.get(actor_id)
+        if entry is None:
+            return
+        await self._handle_actor_failure(entry, reason)
+
+    async def _handle_actor_failure(self, entry: ActorEntry, reason: str) -> None:
+        if entry.state == ActorState.DEAD:
+            return
+        if entry.max_restarts == -1 or entry.restarts_used < entry.max_restarts:
+            entry.restarts_used += 1
+            entry.state = ActorState.RESTARTING
+            entry.event = asyncio.Event()
+            entry.addr = None
+            logger.info("restarting actor %s (%d/%s): %s",
+                        entry.actor_id.hex()[:8], entry.restarts_used,
+                        entry.max_restarts, reason)
+            await self._schedule_actor(entry)
+        else:
+            entry.state = ActorState.DEAD
+            entry.death_reason = reason
+            entry.event.set()
+            if entry.name:
+                self.named_actors.pop(entry.name, None)
+
+    async def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
+        entry = self.actors.get(actor_id)
+        if entry is None:
+            return
+        if no_restart:
+            entry.max_restarts = entry.restarts_used  # exhaust restarts
+        if entry.node_id and entry.addr:
+            node = self.nodes.get(entry.node_id)
+            if node:
+                try:
+                    await node.client.call("kill_actor_worker", actor_id)
+                except Exception:
+                    pass
+        if no_restart:
+            entry.state = ActorState.DEAD
+            entry.death_reason = "killed via kill_actor"
+            entry.event.set()
+            if entry.name:
+                self.named_actors.pop(entry.name, None)
+
+    async def get_actor_info(self, actor_id: bytes) -> Optional[dict]:
+        e = self.actors.get(actor_id)
+        if e is None:
+            return None
+        return {"state": e.state, "addr": e.addr, "node_id": e.node_id,
+                "death_reason": e.death_reason, "name": e.name}
+
+    async def wait_actor_ready(self, actor_id: bytes,
+                               timeout: float = 120.0) -> dict:
+        e = self.actors.get(actor_id)
+        if e is None:
+            raise KeyError(f"no such actor {actor_id.hex()}")
+        while e.state in (ActorState.PENDING, ActorState.RESTARTING):
+            try:
+                await asyncio.wait_for(e.event.wait(), timeout)
+            except asyncio.TimeoutError:
+                raise TimeoutError("actor not ready within timeout")
+        return {"state": e.state, "addr": e.addr,
+                "death_reason": e.death_reason,
+                "incarnation": e.restarts_used}
+
+    async def get_actor_by_name(self, name: str) -> Optional[dict]:
+        actor_id = self.named_actors.get(name)
+        if actor_id is None:
+            return None
+        info = await self.get_actor_info(actor_id)
+        info["actor_id"] = actor_id
+        spec = self.actors[actor_id]
+        info["spec_blob"] = spec.spec_blob
+        return info
+
+    async def list_actors(self) -> list:
+        return [{
+            "actor_id": e.actor_id, "name": e.name, "state": e.state,
+            "node_id": e.node_id, "restarts": e.restarts_used,
+        } for e in self.actors.values()]
+
+    # ------------------------------------------------------------------
+    # placement groups (2-phase commit; reference:
+    # gcs_placement_group_scheduler.cc prepare/commit)
+    # ------------------------------------------------------------------
+    async def create_placement_group(self, pg_id: bytes, bundles: list,
+                                     strategy: str) -> dict:
+        pg = PGEntry(pg_id, bundles, strategy)
+        self.pgs[pg_id] = pg
+        asyncio.ensure_future(self._schedule_pg(pg))
+        return {"pg_id": pg_id}
+
+    def _plan_pg(self, pg: PGEntry) -> Optional[List[NodeEntry]]:
+        """Choose a node per bundle respecting the strategy; None if infeasible."""
+        nodes = self._alive_nodes()
+        if not nodes:
+            return None
+        avail = {n.node_id: dict(n.resources_available) for n in nodes}
+        by_id = {n.node_id: n for n in nodes}
+        plan: List[NodeEntry] = []
+        if pg.strategy in ("STRICT_PACK", "PACK"):
+            # Try to fit everything on one node first.
+            for n in nodes:
+                trial = dict(avail[n.node_id])
+                if all(resources_fit(trial, b) and
+                       (resources_sub(trial, b) or True)
+                       for b in pg.bundles):
+                    return [n] * len(pg.bundles)
+            if pg.strategy == "STRICT_PACK":
+                return None
+        if pg.strategy == "STRICT_SPREAD" and len(pg.bundles) > len(nodes):
+            return None
+        used_nodes: set = set()
+        for i, bundle in enumerate(pg.bundles):
+            placed = None
+            candidates = sorted(nodes, key=lambda n: len(
+                [p for p in plan if p.node_id == n.node_id]))
+            for n in candidates:
+                if pg.strategy == "STRICT_SPREAD" and n.node_id in used_nodes:
+                    continue
+                if resources_fit(avail[n.node_id], bundle):
+                    resources_sub(avail[n.node_id], bundle)
+                    placed = n
+                    used_nodes.add(n.node_id)
+                    break
+            if placed is None:
+                return None
+            plan.append(placed)
+        return [by_id[n.node_id] for n in plan]
+
+    async def _schedule_pg(self, pg: PGEntry) -> None:
+        for _ in range(150):  # keep trying while cluster changes
+            plan = self._plan_pg(pg)
+            if plan is not None:
+                # Phase 1: prepare all bundles.
+                prepared = []
+                ok = True
+                for i, node in enumerate(plan):
+                    try:
+                        got = await node.client.call(
+                            "prepare_bundle", pg.pg_id, i, pg.bundles[i])
+                        if got:
+                            prepared.append((node, i))
+                        else:
+                            ok = False
+                            break
+                    except Exception:
+                        ok = False
+                        break
+                if ok:
+                    # Phase 2: commit.
+                    for node, i in prepared:
+                        await node.client.call("commit_bundle", pg.pg_id, i)
+                        pg.bundle_nodes[i] = node.node_id
+                    pg.state = PGState.CREATED
+                    pg.event.set()
+                    return
+                for node, i in prepared:  # rollback
+                    try:
+                        await node.client.call("return_bundle", pg.pg_id, i)
+                    except Exception:
+                        pass
+            await asyncio.sleep(0.2)
+        pg.state = PGState.REMOVED
+        pg.event.set()
+
+    async def wait_pg_ready(self, pg_id: bytes, timeout: float = 60.0) -> str:
+        pg = self.pgs.get(pg_id)
+        if pg is None:
+            raise KeyError("no such placement group")
+        if pg.state == PGState.PENDING:
+            try:
+                await asyncio.wait_for(pg.event.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+        return pg.state
+
+    async def remove_placement_group(self, pg_id: bytes) -> None:
+        pg = self.pgs.pop(pg_id, None)
+        if pg is None:
+            return
+        for i, node_id in enumerate(pg.bundle_nodes):
+            node = self.nodes.get(node_id) if node_id else None
+            if node and node.state == NodeState.ALIVE:
+                try:
+                    await node.client.call("return_bundle", pg_id, i)
+                except Exception:
+                    pass
+        pg.state = PGState.REMOVED
+
+    async def get_pg_info(self, pg_id: bytes) -> Optional[dict]:
+        pg = self.pgs.get(pg_id)
+        if pg is None:
+            return None
+        return {"state": pg.state, "bundles": pg.bundles,
+                "strategy": pg.strategy, "bundle_nodes": pg.bundle_nodes}
+
+    # ------------------------------------------------------------------
+    # KV store (reference: gcs_kv_manager.cc; function table in ns "fn")
+    # ------------------------------------------------------------------
+    async def kv_put(self, ns: str, key: str, value: bytes,
+                     overwrite: bool = True) -> bool:
+        space = self.kv.setdefault(ns, {})
+        if not overwrite and key in space:
+            return False
+        space[key] = value
+        return True
+
+    async def kv_get(self, ns: str, key: str) -> Optional[bytes]:
+        return self.kv.get(ns, {}).get(key)
+
+    async def kv_del(self, ns: str, key: str) -> bool:
+        return self.kv.get(ns, {}).pop(key, None) is not None
+
+    async def kv_keys(self, ns: str, prefix: str = "") -> list:
+        return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
+
+    # ------------------------------------------------------------------
+    # jobs / misc
+    # ------------------------------------------------------------------
+    async def register_job(self, driver_addr) -> bytes:
+        job_id = self._next_job.to_bytes(4, "big")
+        self._next_job += 1
+        self.jobs[job_id] = {"driver_addr": tuple(driver_addr),
+                             "start_time": time.time(), "state": "RUNNING"}
+        return job_id
+
+    async def finish_job(self, job_id: bytes) -> None:
+        if job_id in self.jobs:
+            self.jobs[job_id]["state"] = "FINISHED"
+
+    async def cluster_resources(self) -> dict:
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in self._alive_nodes():
+            resources_add(total, n.resources_total)
+            resources_add(avail, n.resources_available)
+        return {"total": total, "available": avail}
+
+    async def ping(self) -> str:
+        return "pong"
+
+    # ------------------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        server = RpcServer("controller")
+        server.register_object(self)
+        port = await server.start_tcp(host, port)
+        self._server = server
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        logger.info("controller listening on %s:%d", host, port)
+        return port
+
+
+def main() -> None:
+    """Entry point: `python -m ray_tpu.core.controller --port N`."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args()
+
+    async def run():
+        c = Controller()
+        port = await c.start(args.host, args.port)
+        print(f"CONTROLLER_PORT={port}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,855 @@
+"""CoreWorker — the per-process runtime library (driver and workers alike).
+
+Analogue of the reference's core worker (reference:
+src/ray/core_worker/core_worker.cc, with task_submission/normal_task_submitter.cc
+lease+push, task_manager.cc owner ledger + lineage, reference_count.cc
+distributed refcounting, store_provider/ memory+plasma providers, and
+task_execution/task_receiver.cc ordered actor queues; Python surface mirrored
+from python/ray/_private/worker.py and python/ray/_raylet.pyx).
+
+One instance per process. Owns:
+  * a background asyncio IO thread running an RPC server (the core-worker
+    service: push_task, object status/location, borrow accounting)
+  * the ownership ledger: every object this process created (task returns and
+    puts) with state, inline value or store locations, refcounts, and the
+    creating TaskSpec for lineage reconstruction
+  * task submission: lease a worker from the local node agent (spillback
+    handled agent-side), push the spec directly to the leased worker, retry on
+    worker failure
+  * task execution (worker mode): ordered actor queues, function cache backed
+    by the controller KV function table
+  * get/put/wait against the in-process memory store + shared-memory store
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import hashlib
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu.core import serialization
+from ray_tpu.core.common import (Address, GetTimeoutError, ObjectLostError,
+                                 TaskError, TaskSpec, WorkerCrashedError)
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_store import MappedObject
+from ray_tpu.core.ref import ActorHandle, ObjectRef, set_core_worker
+from ray_tpu.core.rpc import (RpcApplicationError, RpcClient,
+                              RpcConnectionLost, RpcServer)
+from ray_tpu.utils import get_logger
+from ray_tpu.utils.config import GlobalConfig
+
+logger = get_logger("core_worker")
+
+PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
+
+
+class ObjectEntry:
+    __slots__ = ("state", "inline", "locations", "size", "local_refs",
+                 "borrow_refs", "creating_task", "event", "error")
+
+    def __init__(self):
+        self.state = PENDING
+        self.inline: Optional[Tuple[bytes, bytes]] = None
+        self.locations: set = set()  # {(node_id, (host, port))}
+        self.size = 0
+        self.local_refs = 0
+        self.borrow_refs = 0
+        self.creating_task: Optional[TaskSpec] = None
+        self.event: Optional[asyncio.Event] = None
+        self.error: Optional[BaseException] = None
+
+
+class CoreWorker:
+    def __init__(self, mode: str, agent_addr: Address,
+                 controller_addr: Address, session_dir: str = "/tmp"):
+        self.mode = mode  # "driver" | "worker"
+        self.worker_id = WorkerID.random()
+        self.agent_addr = agent_addr
+        self.controller_addr = controller_addr
+        self.session_dir = session_dir
+        self.node_id: Optional[bytes] = None
+        self.store_dir: Optional[str] = None
+        self.port: int = 0
+
+        self._loop = asyncio.new_event_loop()
+        self._io_thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True, name="cw-io")
+        self._io_thread.start()
+
+        self.objects: Dict[bytes, ObjectEntry] = {}
+        self._local_ref_counts: Dict[bytes, int] = {}
+        self._func_cache: Dict[bytes, Any] = {}
+        self._exported_funcs: set = set()
+        self._actor_instance: Any = None
+        self._actor_id: Optional[bytes] = None
+        # actor-task ordering: caller_id -> next expected seqno / buffer
+        self._actor_seqno: Dict[bytes, int] = {}
+        self._actor_buffer: Dict[bytes, Dict[int, tuple]] = {}
+        self._actor_cv: Optional[asyncio.Condition] = None
+        self._exec_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task-exec")
+        self._worker_clients: Dict[Address, RpcClient] = {}
+        # actor_id -> (addr, client, incarnation)
+        self._actor_clients: Dict[bytes, Tuple[Address, RpcClient, int]] = {}
+        # Send-side seqnos are assigned per (actor, incarnation) at push time
+        # so a restarted actor (which expects 0 again) stays in sync.
+        self._actor_seq_out: Dict[bytes, int] = {}
+        self._next_put_index = 0
+
+        self._run(self._async_init()).result()
+        set_core_worker(self)
+
+    # ------------------------------------------------------------------
+    # io-thread plumbing
+    # ------------------------------------------------------------------
+    def _run(self, coro) -> concurrent.futures.Future:
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _async_init(self) -> None:
+        self.agent = RpcClient(self.agent_addr)
+        self.controller = RpcClient(self.controller_addr)
+        server = RpcServer("core_worker")
+        server.register_object(self, prefix="")
+        self.port = await server.start_tcp("127.0.0.1", 0)
+        self._server = server
+        reply = await self.agent.call("register_worker",
+                                      self.worker_id.binary(), os.getpid(),
+                                      self.port)
+        self.node_id = reply["node_id"]
+        self.store_dir = reply["store_dir"]
+
+    @property
+    def address(self) -> Address:
+        return ("127.0.0.1", self.port)
+
+    def _client_for_worker(self, addr: Address) -> RpcClient:
+        addr = tuple(addr)
+        c = self._worker_clients.get(addr)
+        if c is None:
+            c = RpcClient(addr, max_retries=0)
+            self._worker_clients[addr] = c
+        return c
+
+    # ------------------------------------------------------------------
+    # ownership ledger helpers
+    # ------------------------------------------------------------------
+    def _entry(self, oid: bytes, create: bool = False) -> Optional[ObjectEntry]:
+        e = self.objects.get(oid)
+        if e is None and create:
+            e = ObjectEntry()
+            self.objects[oid] = e
+        return e
+
+    def _mark_ready_inline(self, oid: bytes, data: bytes, meta: bytes) -> None:
+        e = self._entry(oid, create=True)
+        e.state = READY
+        e.inline = (data, meta)
+        e.size = len(data)
+        if e.event:
+            e.event.set()
+
+    def _mark_ready_stored(self, oid: bytes, node_id: bytes, addr: Address,
+                           size: int) -> None:
+        e = self._entry(oid, create=True)
+        e.state = READY
+        e.locations.add((node_id, tuple(addr)))
+        e.size = size
+        if e.event:
+            e.event.set()
+
+    def _mark_error(self, oid: bytes, err: BaseException) -> None:
+        e = self._entry(oid, create=True)
+        e.state = ERROR
+        e.error = err
+        if e.event:
+            e.event.set()
+
+    async def _wait_entry_ready(self, oid: bytes, timeout: Optional[float]
+                                ) -> ObjectEntry:
+        e = self._entry(oid, create=True)
+        if e.state == PENDING:
+            if e.event is None:
+                e.event = asyncio.Event()
+            if timeout is None:
+                await e.event.wait()
+            else:
+                await asyncio.wait_for(e.event.wait(), timeout)
+        return e
+
+    # ------------------------------------------------------------------
+    # ref counting (core-worker service + local hooks)
+    # ------------------------------------------------------------------
+    def add_local_ref(self, ref: ObjectRef) -> None:
+        k = ref.binary()
+        self._local_ref_counts[k] = self._local_ref_counts.get(k, 0) + 1
+
+    def remove_local_ref(self, ref: ObjectRef) -> None:
+        k = ref.binary()
+        n = self._local_ref_counts.get(k)
+        if n is None:
+            return
+        if n <= 1:
+            self._local_ref_counts.pop(k, None)
+            owner = ref.owner_addr
+            try:
+                if owner is None or tuple(owner) == self.address:
+                    self._run(self._on_owned_ref_dropped(k))
+                else:
+                    self._run(self._notify_remove_borrow(tuple(owner), k))
+            except RuntimeError:
+                pass  # interpreter/loop shutdown
+        else:
+            self._local_ref_counts[k] = n - 1
+
+    def on_ref_deserialized(self, ref: ObjectRef) -> None:
+        k = ref.binary()
+        first = k not in self._local_ref_counts
+        self.add_local_ref(ref)
+        owner = ref.owner_addr
+        if first and owner is not None and tuple(owner) != self.address:
+            try:
+                self._run(self._notify_add_borrow(tuple(owner), k))
+            except RuntimeError:
+                pass
+
+    async def _notify_add_borrow(self, owner: Address, oid: bytes) -> None:
+        try:
+            await self._client_for_worker(owner).call("add_borrow", oid)
+        except Exception:
+            pass
+
+    async def _notify_remove_borrow(self, owner: Address, oid: bytes) -> None:
+        try:
+            await self._client_for_worker(owner).call("remove_borrow", oid)
+        except Exception:
+            pass
+
+    async def add_borrow(self, oid: bytes) -> None:
+        e = self._entry(oid, create=True)
+        e.borrow_refs += 1
+
+    async def remove_borrow(self, oid: bytes) -> None:
+        e = self._entry(oid)
+        if e is None:
+            return
+        e.borrow_refs -= 1
+        await self._maybe_free(oid)
+
+    async def _on_owned_ref_dropped(self, oid: bytes) -> None:
+        e = self._entry(oid)
+        if e is None:
+            return
+        await self._maybe_free(oid)
+
+    async def _maybe_free(self, oid: bytes) -> None:
+        e = self._entry(oid)
+        if e is None:
+            return
+        if oid in self._local_ref_counts:
+            return
+        if e.borrow_refs > 0:
+            return
+        # Free: drop store copies everywhere, forget the entry.
+        self.objects.pop(oid, None)
+        for node_id, addr in list(e.locations):
+            try:
+                peer = self._client_for_worker(tuple(addr))
+                await peer.call("free_objects", [oid])
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # core-worker RPC service (called by agents/other workers)
+    # ------------------------------------------------------------------
+    async def add_location(self, oid: bytes, node_id: bytes, addr,
+                           size: int) -> None:
+        self._mark_ready_stored(oid, node_id, tuple(addr), size)
+
+    async def get_object_status(self, oid: bytes,
+                                timeout: float = 60.0) -> dict:
+        try:
+            e = await self._wait_entry_ready(oid, timeout)
+        except asyncio.TimeoutError:
+            return {"status": "pending"}
+        if e.state == ERROR:
+            return {"status": "error",
+                    "error": serialization.serialize(e.error).to_bytes(),
+                    "error_meta": serialization.serialize(e.error).meta()}
+        if e.inline is not None:
+            return {"status": "inline", "data": e.inline[0],
+                    "meta": e.inline[1]}
+        return {"status": "stored", "locations": list(e.locations),
+                "size": e.size}
+
+    async def ping(self) -> str:
+        return "pong"
+
+    # ------------------------------------------------------------------
+    # put / get / wait
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_put()
+        sv = serialization.serialize(value)
+        ref = ObjectRef(oid, self.address)
+        self.add_local_ref(ref)
+        self._run(self._do_put(oid.binary(), sv)).result()
+        return ref
+
+    async def _do_put(self, oid: bytes, sv) -> None:
+        e = self._entry(oid, create=True)
+        e.creating_task = None
+        for r in sv.contained_refs:
+            await self.add_borrow(r.binary()) if self._is_self_owned(r) else \
+                await self._notify_add_borrow(tuple(r.owner_addr), r.binary())
+        if sv.total_size <= GlobalConfig.max_direct_call_object_size:
+            self._mark_ready_inline(oid, sv.to_bytes(), sv.meta())
+            return
+        await self._store_put(oid, sv)
+        self._mark_ready_stored(oid, self.node_id, self.agent_addr,
+                                sv.total_size)
+
+    def _is_self_owned(self, ref: ObjectRef) -> bool:
+        return ref.owner_addr is None or tuple(ref.owner_addr) == self.address
+
+    async def _store_put(self, oid: bytes, sv) -> None:
+        path = await self.agent.call("store_create", oid, sv.total_size,
+                                     len(sv.meta()))
+        total = sv.total_size + len(sv.meta())
+        import mmap as mmap_mod
+        with open(path, "r+b") as f:
+            with mmap_mod.mmap(f.fileno(), total) as m:
+                mv = memoryview(m)
+                sv.write_into(mv[:sv.total_size])
+                mv[sv.total_size:] = sv.meta()
+                mv.release()
+        await self.agent.call("store_seal", oid, None, total)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
+            ) -> List[Any]:
+        async def _gather():
+            return await asyncio.gather(
+                *[self.get_async(r, timeout) for r in refs])
+
+        try:
+            return list(self._run(_gather()).result())
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(f"get timed out after {timeout}s")
+
+    def get_future(self, ref: ObjectRef) -> concurrent.futures.Future:
+        return self._run(self.get_async(ref))
+
+    async def get_async(self, ref: ObjectRef,
+                        timeout: Optional[float] = None) -> Any:
+        oid = ref.binary()
+        if self._is_self_owned(ref):
+            e = await self._wait_entry_ready(oid, timeout)
+            if e.state == ERROR:
+                raise e.error
+            if e.inline is not None:
+                return serialization.deserialize(e.inline[0], e.inline[1])
+            return await self._get_from_store(oid, e)
+        # Borrowed ref: ask the owner.
+        owner = self._client_for_worker(tuple(ref.owner_addr))
+        deadline = None if timeout is None else \
+            asyncio.get_running_loop().time() + timeout
+        while True:
+            remaining = 9.0 if deadline is None else \
+                min(9.0, deadline - asyncio.get_running_loop().time())
+            if remaining <= 0:
+                raise asyncio.TimeoutError()
+            try:
+                status = await owner.call("get_object_status", oid,
+                                          timeout=remaining)
+            except RpcConnectionLost:
+                raise ObjectLostError(
+                    f"owner of {ref} is unreachable") from None
+            if status["status"] != "pending":
+                break
+        if status["status"] == "error":
+            raise serialization.deserialize(status["error"],
+                                            status["error_meta"])
+        if status["status"] == "inline":
+            return serialization.deserialize(status["data"], status["meta"])
+        return await self._fetch_stored(oid, status["locations"],
+                                        ref.owner_addr)
+
+    async def _get_from_store(self, oid: bytes, e: ObjectEntry) -> Any:
+        ok = await self._ensure_local(oid, list(e.locations))
+        if not ok:
+            # All copies lost: try lineage reconstruction.
+            if e.creating_task is not None:
+                await self._resubmit_task(e)
+                e2 = await self._wait_entry_ready(oid, None)
+                if e2.state == ERROR:
+                    raise e2.error
+                if e2.inline is not None:
+                    return serialization.deserialize(*e2.inline)
+                ok = await self._ensure_local(oid, list(e2.locations))
+            if not ok:
+                raise ObjectLostError(
+                    f"object {ObjectID(oid)} lost (all copies gone)")
+        return await self._map_local(oid)
+
+    async def _fetch_stored(self, oid: bytes, locations, owner_addr) -> Any:
+        ok = await self._ensure_local(oid, locations)
+        if not ok:
+            raise ObjectLostError(f"object {ObjectID(oid)} lost")
+        return await self._map_local(oid)
+
+    async def _ensure_local(self, oid: bytes, locations) -> bool:
+        if await self.agent.call("store_contains", oid) == 1:
+            return True
+        for node_id, addr in locations:
+            if node_id == self.node_id:
+                continue  # local agent lost it; try others
+            try:
+                await self.agent.call("pull_object", oid, tuple(addr))
+                return True
+            except Exception as e:
+                logger.debug("pull of %s from %s failed: %r",
+                             ObjectID(oid), addr, e)
+        return await self.agent.call("store_contains", oid) == 1
+
+    async def _map_local(self, oid: bytes) -> Any:
+        got = await self.agent.call("store_get", oid)
+        if got is None:
+            raise ObjectLostError(f"object {ObjectID(oid)} vanished locally")
+        path, ds, ms = got
+        try:
+            mo = MappedObject(path, ds, ms)
+            # Deserialized arrays keep views into the mapping alive; the pin
+            # can be dropped immediately (tmpfs pages live until munmap).
+            return serialization.deserialize(mo.data, bytes(mo.meta))
+        finally:
+            await self.agent.call("store_release", oid)
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None) -> Tuple[list, list]:
+        return self._run(self._wait_async(list(refs), num_returns,
+                                          timeout)).result()
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        tasks = {asyncio.ensure_future(self._ready_probe(r)): r for r in refs}
+        done_refs: list = []
+        pending = set(tasks)
+        deadline = None if timeout is None else \
+            asyncio.get_running_loop().time() + timeout
+        while pending and len(done_refs) < num_returns:
+            wait_timeout = None if deadline is None else \
+                max(0.0, deadline - asyncio.get_running_loop().time())
+            done, pending = await asyncio.wait(
+                pending, timeout=wait_timeout,
+                return_when=asyncio.FIRST_COMPLETED)
+            if not done:
+                break
+            for d in done:
+                done_refs.append(tasks[d])
+        for p in pending:
+            p.cancel()
+        ready = [r for r in refs if r in done_refs][:num_returns]
+        not_ready = [r for r in refs if r not in ready]
+        return ready, not_ready
+
+    async def _ready_probe(self, ref: ObjectRef) -> None:
+        oid = ref.binary()
+        if self._is_self_owned(ref):
+            await self._wait_entry_ready(oid, None)
+            return
+        owner = self._client_for_worker(tuple(ref.owner_addr))
+        while True:
+            status = await owner.call("get_object_status", oid, timeout=9.0)
+            if status["status"] != "pending":
+                return
+
+    # ------------------------------------------------------------------
+    # function table
+    # ------------------------------------------------------------------
+    def _export_function(self, func: Any) -> bytes:
+        blob = cloudpickle.dumps(func)
+        func_id = hashlib.sha1(blob).digest()
+        if func_id not in self._exported_funcs:
+            self._run(self.controller.call(
+                "kv_put", "fn", func_id.hex(), blob, False)).result()
+            self._exported_funcs.add(func_id)
+        return func_id
+
+    async def _load_function(self, func_id: bytes) -> Any:
+        fn = self._func_cache.get(func_id)
+        if fn is None:
+            blob = await self.controller.call("kv_get", "fn", func_id.hex())
+            if blob is None:
+                raise RuntimeError(f"function {func_id.hex()} not found")
+            fn = cloudpickle.loads(blob)
+            self._func_cache[func_id] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # task submission (owner side)
+    # ------------------------------------------------------------------
+    def _serialize_args(self, args: tuple, kwargs: dict) -> list:
+        # args encoded positionally; kwargs appended as ("k", name, *wire)
+        out = []
+        for a in args:
+            out.append(("p",) + self._wire_value(a))
+        for k, v in kwargs.items():
+            out.append(("k", k) + self._wire_value(v))
+        return out
+
+    def _wire_value(self, v: Any) -> tuple:
+        if isinstance(v, ObjectRef):
+            self.add_local_ref(v)  # held until task completes
+            return ("r", v.binary(), v.owner_addr or self.address)
+        sv = serialization.serialize(v)
+        for r in sv.contained_refs:
+            self.add_local_ref(r)
+        if sv.total_size > GlobalConfig.max_direct_call_object_size:
+            # Promote big args to the store under a fresh put id.
+            oid = ObjectID.from_put()
+            ref = ObjectRef(oid, self.address)
+            self.add_local_ref(ref)
+            self._run(self._do_put(oid.binary(), sv)).result()
+            return ("r", oid.binary(), self.address)
+        return ("v", sv.to_bytes(), sv.meta())
+
+    def submit_task(self, func, args, kwargs, *, num_returns: int = 1,
+                    resources: Optional[dict] = None, max_retries: int = 0,
+                    placement_group=None, pg_bundle_index: int = -1,
+                    scheduling_strategy=None, name: str = "") -> List[ObjectRef]:
+        func_id = self._export_function(func)
+        task_id = TaskID.random()
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            name=name or getattr(func, "__name__", "task"),
+            func_id=func_id,
+            args=self._serialize_args(args, kwargs),
+            num_returns=num_returns,
+            resources=resources or {"CPU": 1.0},
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            max_retries=max_retries,
+            placement_group=placement_group,
+            pg_bundle_index=pg_bundle_index,
+            scheduling_strategy=scheduling_strategy,
+        )
+        refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(task_id, i)
+            ref = ObjectRef(oid, self.address)
+            self.add_local_ref(ref)
+            e = self._entry(oid.binary(), create=True)
+            e.creating_task = spec
+            refs.append(ref)
+        self._run(self._submit_and_track(spec))
+        return refs
+
+    async def _submit_and_track(self, spec: TaskSpec) -> None:
+        try:
+            await self._submit_with_retries(spec)
+        except BaseException as e:  # mark all returns failed
+            for i in range(spec.num_returns):
+                oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+                self._mark_error(oid.binary(), e if isinstance(e, Exception)
+                                 else WorkerCrashedError(repr(e)))
+
+    async def _submit_with_retries(self, spec: TaskSpec) -> None:
+        attempts = spec.max_retries + 1
+        last_exc: Optional[BaseException] = None
+        for attempt in range(attempts):
+            try:
+                await self._submit_once(spec)
+                return
+            except (RpcConnectionLost, WorkerCrashedError, OSError) as e:
+                last_exc = e
+                spec.retry_count += 1
+                await asyncio.sleep(GlobalConfig.task_retry_delay_ms / 1000)
+        raise WorkerCrashedError(
+            f"task {spec.name} failed after {attempts} attempts: {last_exc!r}")
+
+    async def _submit_once(self, spec: TaskSpec) -> None:
+        while True:
+            lease = await self.agent.call(
+                "request_lease", spec.resources, spec.placement_group,
+                spec.pg_bundle_index, spec.scheduling_strategy)
+            if lease.get("granted"):
+                break
+            await asyncio.sleep(0.05)
+        worker_addr = tuple(lease["worker_addr"])
+        lease_node = lease.get("spilled_to", self.agent_addr)
+        try:
+            reply = await self._client_for_worker(worker_addr).call(
+                "push_task", cloudpickle.dumps(spec))
+            self._process_task_reply(spec, reply)
+        finally:
+            agent = self.agent if lease_node == self.agent_addr else \
+                self._client_for_worker(tuple(lease_node))
+            asyncio.ensure_future(self._return_lease_quiet(
+                agent, lease["lease_id"]))
+        self._release_arg_refs(spec)
+
+    async def _return_lease_quiet(self, agent: RpcClient, lease_id) -> None:
+        try:
+            await agent.call("return_lease", lease_id)
+        except Exception:
+            pass
+
+    def _release_arg_refs(self, spec: TaskSpec) -> None:
+        for a in spec.args:
+            if a[0] == "r":
+                ref = ObjectRef(ObjectID(a[1]), tuple(a[2]))
+                self.remove_local_ref(ref)
+            elif a[0] == "k" and a[2] == "r":
+                ref = ObjectRef(ObjectID(a[3]), tuple(a[4]))
+                self.remove_local_ref(ref)
+
+    def _process_task_reply(self, spec: TaskSpec, reply: dict) -> None:
+        if reply.get("error") is not None:
+            err = serialization.deserialize(reply["error"],
+                                            reply["error_meta"])
+            for i in range(spec.num_returns):
+                oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+                self._mark_error(oid.binary(), err)
+            return
+        for i, ret in enumerate(reply["returns"]):
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+            if ret[0] == "inline":
+                self._mark_ready_inline(oid.binary(), ret[1], ret[2])
+            else:  # ("stored", node_id, agent_addr, size)
+                self._mark_ready_stored(oid.binary(), ret[1], tuple(ret[2]),
+                                        ret[3])
+
+    async def _resubmit_task(self, e: ObjectEntry) -> None:
+        """Lineage reconstruction: re-run the creating task."""
+        spec = e.creating_task
+        assert spec is not None
+        logger.info("reconstructing via resubmit of task %s", spec.name)
+        for i in range(spec.num_returns):
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+            ent = self._entry(oid.binary(), create=True)
+            ent.state = PENDING
+            ent.locations.clear()
+            ent.inline = None
+            ent.event = asyncio.Event()
+        await self._submit_with_retries(spec)
+
+    # ------------------------------------------------------------------
+    # actors (owner side)
+    # ------------------------------------------------------------------
+    def create_actor(self, cls, args, kwargs, *, name: str = "",
+                     max_restarts: int = 0, max_task_retries: int = 0,
+                     resources: Optional[dict] = None, placement_group=None,
+                     pg_bundle_index: int = -1) -> ActorHandle:
+        actor_id = ActorID.random()
+        creation = {
+            "cls_blob": cloudpickle.dumps(cls),
+            "args": self._serialize_args(args, kwargs),
+            "actor_id": actor_id.binary(),
+            "max_restarts": max_restarts,
+        }
+        spec_blob = cloudpickle.dumps(creation)
+        placement = ((placement_group, pg_bundle_index)
+                     if placement_group is not None else None)
+        self._run(self.controller.call(
+            "create_actor", actor_id.binary(), spec_blob, name, max_restarts,
+            resources or {"CPU": 1.0}, placement)).result()
+        method_names = [m for m in dir(cls)
+                        if not m.startswith("_") and callable(getattr(cls, m))]
+        return ActorHandle(actor_id, name or cls.__name__, method_names,
+                           max_task_retries)
+
+    def submit_actor_task(self, handle: ActorHandle, method: str, args,
+                          kwargs, *, num_returns: int = 1) -> ObjectRef:
+        actor_id = handle.actor_id.binary()
+        task_id = TaskID.random()
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            name=f"{handle._name}.{method}",
+            func_id=b"",
+            args=self._serialize_args(args, kwargs),
+            num_returns=num_returns,
+            resources={},
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            actor_id=actor_id,
+            method_name=method,
+            seqno=-1,  # assigned at push time (incarnation-aware)
+            caller_id=self.worker_id.binary(),
+            max_retries=handle._max_task_retries,
+        )
+        refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(task_id, i)
+            ref = ObjectRef(oid, self.address)
+            self.add_local_ref(ref)
+            self._entry(oid.binary(), create=True)
+            refs.append(ref)
+        self._run(self._submit_actor_and_track(spec))
+        return refs[0] if num_returns == 1 else refs
+
+    async def _submit_actor_and_track(self, spec: TaskSpec) -> None:
+        try:
+            await self._submit_actor_with_retries(spec)
+        except BaseException as e:
+            for i in range(spec.num_returns):
+                oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+                self._mark_error(oid.binary(), e if isinstance(e, Exception)
+                                 else WorkerCrashedError(repr(e)))
+
+    async def _actor_client(self, actor_id: bytes,
+                            refresh: bool = False) -> RpcClient:
+        cached = None if refresh else self._actor_clients.get(actor_id)
+        if cached is not None:
+            return cached[1]
+        info = await self.controller.call("wait_actor_ready", actor_id)
+        if info["state"] != "ALIVE":
+            from ray_tpu.core.common import ActorDiedError
+            raise ActorDiedError(
+                f"actor is {info['state']}: {info.get('death_reason', '')}")
+        addr = tuple(info["addr"])
+        incarnation = info.get("incarnation", 0)
+        prev = self._actor_clients.get(actor_id)
+        if prev is None or prev[2] != incarnation:
+            # New incarnation: the restarted worker expects seqno 0 from every
+            # caller again (its ordering state died with the old process).
+            self._actor_seq_out[actor_id] = 0
+        client = RpcClient(addr, max_retries=0)
+        self._actor_clients[actor_id] = (addr, client, incarnation)
+        return client
+
+    async def _submit_actor_with_retries(self, spec: TaskSpec) -> None:
+        from ray_tpu.core.common import ActorDiedError
+        attempts = spec.max_retries + 1
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
+            try:
+                client = await self._actor_client(spec.actor_id,
+                                                  refresh=attempt > 0)
+                # Assign the per-incarnation send seqno at push time.
+                spec.seqno = self._actor_seq_out.get(spec.actor_id, 0)
+                self._actor_seq_out[spec.actor_id] = spec.seqno + 1
+                reply = await client.call("push_task",
+                                          cloudpickle.dumps(spec))
+                self._process_task_reply(spec, reply)
+                self._release_arg_refs(spec)
+                return
+            except (RpcConnectionLost, ConnectionError, OSError) as e:
+                last = e
+                # Invalidate the cached client so the next submit (this retry
+                # or a future task) re-resolves the actor's current address.
+                self._actor_clients.pop(spec.actor_id, None)
+                await asyncio.sleep(GlobalConfig.task_retry_delay_ms / 1000)
+        raise ActorDiedError(
+            f"actor task {spec.name} failed after {attempts} attempts "
+            f"({last!r})")
+
+    # ------------------------------------------------------------------
+    # task execution (worker side)
+    # ------------------------------------------------------------------
+    async def create_actor_local(self, spec_blob: bytes) -> None:
+        creation = cloudpickle.loads(spec_blob)
+        cls = cloudpickle.loads(creation["cls_blob"])
+        args, kwargs = await self._resolve_args(creation["args"])
+        loop = asyncio.get_running_loop()
+        instance = await loop.run_in_executor(
+            self._exec_pool, lambda: cls(*args, **kwargs))
+        self._actor_instance = instance
+        self._actor_id = creation["actor_id"]
+        self._actor_cv = asyncio.Condition()
+
+    async def push_task(self, spec_blob: bytes) -> dict:
+        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        if spec.is_actor_task:
+            # Enforce per-caller seqno ordering (reference:
+            # task_execution/actor_scheduling_queue.cc).
+            assert self._actor_cv is not None, "not an actor worker"
+            async with self._actor_cv:
+                while spec.seqno != self._actor_seqno.get(spec.caller_id, 0):
+                    await self._actor_cv.wait()
+        try:
+            return await self._execute(spec)
+        finally:
+            if spec.is_actor_task:
+                async with self._actor_cv:
+                    self._actor_seqno[spec.caller_id] = spec.seqno + 1
+                    self._actor_cv.notify_all()
+
+    async def _resolve_args(self, wire_args: list) -> Tuple[list, dict]:
+        args: list = []
+        kwargs: dict = {}
+        for a in wire_args:
+            if a[0] == "p":
+                kind, rest = a[1], a[2:]
+                target = args
+                key = None
+            else:  # ("k", name, kind, ...)
+                key = a[1]
+                kind, rest = a[2], a[3:]
+                target = None
+            if kind == "v":
+                val = serialization.deserialize(rest[0], rest[1])
+            else:
+                ref = ObjectRef(ObjectID(rest[0]), tuple(rest[1]))
+                self.on_ref_deserialized(ref)
+                val = await self.get_async(ref)
+            if key is None:
+                args.append(val)
+            else:
+                kwargs[key] = val
+        return args, kwargs
+
+    async def _execute(self, spec: TaskSpec) -> dict:
+        loop = asyncio.get_running_loop()
+        try:
+            args, kwargs = await self._resolve_args(spec.args)
+            if spec.is_actor_task:
+                method = getattr(self._actor_instance, spec.method_name)
+                fn = lambda: method(*args, **kwargs)  # noqa: E731
+            else:
+                func = await self._load_function(spec.func_id)
+                fn = lambda: func(*args, **kwargs)  # noqa: E731
+            result = await loop.run_in_executor(self._exec_pool, fn)
+        except BaseException as e:  # user error -> error payload to owner
+            tb = traceback.format_exc()
+            err = TaskError(repr(e), tb)
+            sv = serialization.serialize_error(err)
+            return {"error": sv.to_bytes(), "error_meta": sv.meta()}
+
+        results = (result,) if spec.num_returns == 1 else tuple(result)
+        returns = []
+        for i, value in enumerate(results):
+            sv = serialization.serialize(value)
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+            if sv.total_size <= GlobalConfig.max_direct_call_object_size:
+                returns.append(("inline", sv.to_bytes(), sv.meta()))
+            else:
+                await self._store_put(oid.binary(), sv)
+                returns.append(("stored", self.node_id, self.agent_addr,
+                                sv.total_size))
+        return {"error": None, "returns": returns}
+
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        try:
+            self._exec_pool.shutdown(wait=False)
+        except Exception:
+            pass
+
+        async def _cancel_all():
+            for t in asyncio.all_tasks():
+                if t is not asyncio.current_task():
+                    t.cancel()
+
+        try:
+            self._run(_cancel_all()).result(timeout=1.0)
+        except Exception:
+            pass
+        try:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._io_thread.join(timeout=2.0)
+        except Exception:
+            pass

@@ -1,0 +1,540 @@
+"""Node agent — the per-node runtime daemon (raylet equivalent).
+
+Analogue of the reference's raylet (reference: src/ray/raylet/node_manager.cc
+lease service + src/ray/raylet/worker_pool.cc + scheduling/cluster_lease_manager.cc
+spillback + placement_group_resource_manager.cc bundles), with the plasma store
+hosted in-process (reference: src/ray/object_manager/plasma/store_runner.cc)
+and node-to-node chunked object transfer (reference:
+src/ray/object_manager/object_manager.cc Push/Pull).
+
+Responsibilities:
+  * register + heartbeat with the controller (resource gossip)
+  * worker pool: spawn/reuse python worker processes; dedicated actor workers
+  * lease-based task scheduling: grant locally when resources fit, else
+    spillback via the controller's hybrid policy to another agent
+  * placement-group bundle prepare/commit/return (2-phase commit participant)
+  * shared-memory object store host: create/seal/get control plane for local
+    workers (data plane is direct mmap), seal-waiters, location registration
+    with object owners, pull-from-remote chunked transfer
+  * child worker monitoring: actor death reporting, lease cleanup
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.common import (Address, resources_add, resources_fit,
+                                 resources_sub)
+from ray_tpu.core.ids import NodeID, ObjectID
+from ray_tpu.core.object_store import LocalObjectStore
+from ray_tpu.core.rpc import RpcClient, RpcServer
+from ray_tpu.utils import get_logger
+from ray_tpu.utils.config import GlobalConfig
+
+logger = get_logger("node_agent")
+
+
+class _ExternalProc:
+    """Process we did not spawn (the driver); liveness via kill(pid, 0)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except OSError:
+            self.returncode = -1
+            return -1
+
+    def terminate(self) -> None:
+        pass  # never kill processes we don't own
+
+
+class WorkerProc:
+    def __init__(self, proc: subprocess.Popen, worker_id: bytes):
+        self.proc = proc
+        self.worker_id = worker_id
+        self.addr: Optional[Address] = None
+        self.client: Optional[RpcClient] = None
+        self.ready = asyncio.Event()
+        self.dedicated_actor: Optional[bytes] = None
+        self.current_lease: Optional[bytes] = None
+
+
+class NodeAgent:
+    def __init__(self, controller_addr: Address, resources: Dict[str, float],
+                 session_dir: str, labels: Optional[Dict[str, str]] = None,
+                 host: str = "127.0.0.1"):
+        self.node_id = NodeID.random()
+        self.controller_addr = controller_addr
+        self.controller = RpcClient(controller_addr)
+        self.host = host
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.labels = labels or {}
+        self.session_dir = session_dir
+        self.port: Optional[int] = None
+
+        store_dir = os.path.join("/dev/shm", "ray_tpu",
+                                 os.path.basename(session_dir),
+                                 self.node_id.hex()[:12])
+        os.makedirs(os.path.dirname(store_dir), exist_ok=True)
+        self.store = LocalObjectStore(
+            store_dir, GlobalConfig.object_store_memory_bytes)
+        self._seal_waiters: Dict[bytes, asyncio.Event] = {}
+        self._pulls: Dict[bytes, asyncio.Future] = {}
+
+        self.workers: Dict[bytes, WorkerProc] = {}       # by worker_id
+        self.idle_workers: List[WorkerProc] = []
+        self._pending_registration: Dict[int, WorkerProc] = {}  # by pid
+        # lease_id -> (worker, resources, pg_id|None, bundle_index)
+        self.leases: Dict[bytes, tuple] = {}
+        self._lease_seq = 0
+        # pg_id -> bundle_index -> resources (prepared or committed)
+        self.bundles: Dict[bytes, Dict[int, Dict[str, float]]] = {}
+        self.bundle_available: Dict[Tuple[bytes, int], Dict[str, float]] = {}
+        self._peer_clients: Dict[Address, RpcClient] = {}
+        self._resource_cv = asyncio.Condition()
+        self._shutdown = False
+
+    # ------------------------------------------------------------------
+    # startup / heartbeat
+    # ------------------------------------------------------------------
+    async def start(self, port: int = 0) -> int:
+        server = RpcServer("node_agent")
+        server.register_object(self)
+        self.port = await server.start_tcp(self.host, port)
+        self._server = server
+        await self.controller.call(
+            "register_node", self.node_id.binary(), (self.host, self.port),
+            self.resources_total, self.labels)
+        asyncio.ensure_future(self._heartbeat_loop())
+        asyncio.ensure_future(self._reap_loop())
+        logger.info("node agent %s on %s:%d resources=%s",
+                    self.node_id.hex()[:8], self.host, self.port,
+                    self.resources_total)
+        return self.port
+
+    async def _heartbeat_loop(self) -> None:
+        period = GlobalConfig.resource_broadcast_period_ms / 1000
+        while not self._shutdown:
+            try:
+                alive = await self.controller.call(
+                    "heartbeat", self.node_id.binary(),
+                    self.resources_available)
+                if not alive:
+                    logger.warning("controller declared this node dead")
+            except Exception as e:
+                logger.debug("heartbeat failed: %r", e)
+            await asyncio.sleep(period)
+
+    async def _reap_loop(self) -> None:
+        """Monitor child worker processes; clean up on death."""
+        while not self._shutdown:
+            await asyncio.sleep(0.1)
+            for wid, w in list(self.workers.items()):
+                if w.proc.poll() is not None:
+                    await self._on_worker_death(w)
+
+    async def _on_worker_death(self, w: WorkerProc) -> None:
+        self.workers.pop(w.worker_id, None)
+        if w in self.idle_workers:
+            self.idle_workers.remove(w)
+        if w.current_lease is not None:
+            lease = self.leases.pop(w.current_lease, None)
+            if lease:
+                _, res, pg, bundle_index = lease
+                if pg is not None:
+                    ba = self.bundle_available.get((pg, bundle_index))
+                    if ba is not None:
+                        resources_add(ba, res)
+                elif res:
+                    await self._free_resources(res)
+            w.current_lease = None
+        if w.dedicated_actor is not None:
+            actor_id = w.dedicated_actor
+            w.dedicated_actor = None
+            try:
+                await self.controller.call(
+                    "report_actor_death", actor_id,
+                    f"worker process exited with code {w.proc.returncode}")
+            except Exception:
+                pass
+
+    async def _free_resources(self, res: Dict[str, float]) -> None:
+        async with self._resource_cv:
+            resources_add(self.resources_available, res)
+            self._resource_cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # worker pool (reference: src/ray/raylet/worker_pool.cc)
+    # ------------------------------------------------------------------
+    def _spawn_worker(self) -> WorkerProc:
+        env = dict(os.environ)
+        env["RAY_TPU_AGENT_ADDR"] = f"{self.host}:{self.port}"
+        env["RAY_TPU_CONTROLLER_ADDR"] = \
+            f"{self.controller_addr[0]}:{self.controller_addr[1]}"
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env, cwd=os.getcwd())
+        w = WorkerProc(proc, b"")
+        self._pending_registration[proc.pid] = w
+        return w
+
+    async def register_worker(self, worker_id: bytes, pid: int, port: int) -> dict:
+        w = self._pending_registration.pop(pid, None)
+        if w is None:  # worker we did not spawn (e.g. the driver): track only
+            w = WorkerProc(_ExternalProc(pid), worker_id)
+        w.worker_id = worker_id
+        w.addr = (self.host, port)
+        w.client = RpcClient(w.addr)
+        self.workers[worker_id] = w
+        w.ready.set()
+        return {"node_id": self.node_id.binary(),
+                "store_dir": self.store._dir}
+
+    async def _pop_worker(self) -> WorkerProc:
+        while self.idle_workers:
+            w = self.idle_workers.pop()
+            if w.proc.poll() is None:
+                return w
+        w = self._spawn_worker()
+        await asyncio.wait_for(w.ready.wait(),
+                               GlobalConfig.worker_register_timeout_s)
+        return w
+
+    def _push_idle(self, w: WorkerProc) -> None:
+        if w.proc.poll() is None and w.dedicated_actor is None:
+            if len(self.idle_workers) < GlobalConfig.worker_pool_max_idle_workers:
+                self.idle_workers.append(w)
+            else:
+                w.proc.terminate()
+
+    # ------------------------------------------------------------------
+    # leases (reference: cluster_lease_manager.cc QueueAndScheduleLease +
+    # spillback ScheduleOnNode)
+    # ------------------------------------------------------------------
+    async def request_lease(self, resources: dict, pg: Optional[bytes] = None,
+                            bundle_index: int = -1, strategy=None,
+                            _no_spill: bool = False) -> dict:
+        # Placement-group tasks must run on the bundle's node.
+        if pg is not None and (pg, bundle_index) not in self.bundle_available \
+                and not _no_spill:
+            info = await self.controller.call("get_pg_info", pg)
+            if info is None or info["state"] != "CREATED":
+                return {"granted": False, "retry": True}
+            node_id = info["bundle_nodes"][bundle_index if bundle_index >= 0 else 0]
+            if node_id != self.node_id.binary():
+                nodes = await self.controller.call("get_nodes")
+                for n in nodes:
+                    if n["node_id"] == node_id:
+                        return await self._spill_to(tuple(n["addr"]), resources,
+                                                    pg, bundle_index, strategy)
+                return {"granted": False, "retry": True}
+
+        avail = (self.bundle_available.get((pg, bundle_index))
+                 if pg is not None else self.resources_available)
+        if avail is not None and resources_fit(avail, resources):
+            resources_sub(avail, resources)
+            try:
+                w = await self._pop_worker()
+            except Exception as e:
+                resources_add(avail, resources)
+                return {"granted": False, "retry": True, "error": repr(e)}
+            self._lease_seq += 1
+            lease_id = self._lease_seq.to_bytes(8, "big") + self.node_id.binary()[:8]
+            w.current_lease = lease_id
+            self.leases[lease_id] = (w, dict(resources), pg, bundle_index)
+            return {"granted": True, "lease_id": lease_id,
+                    "worker_addr": w.addr, "node_id": self.node_id.binary()}
+
+        if _no_spill or pg is not None:
+            return {"granted": False, "retry": True}
+        # Spillback: ask the controller for a feasible node.
+        pick = await self.controller.call("pick_node", resources,
+                                          [self.node_id.binary()], strategy)
+        if pick is None:
+            # Nothing feasible elsewhere either: wait for local resources.
+            return {"granted": False, "retry": True}
+        return await self._spill_to(tuple(pick["addr"]), resources, pg,
+                                    bundle_index, strategy)
+
+    async def _spill_to(self, addr: Address, resources, pg, bundle_index,
+                        strategy) -> dict:
+        peer = self._peer(addr)
+        reply = await peer.call("request_lease", resources, pg, bundle_index,
+                                strategy, _no_spill=True)
+        if reply.get("granted"):
+            reply["spilled_to"] = addr
+        return reply
+
+    async def return_lease(self, lease_id: bytes) -> None:
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        w, res, pg, bundle_index = lease
+        w.current_lease = None
+        if pg is not None:
+            ba = self.bundle_available.get((pg, bundle_index))
+            if ba is not None:
+                resources_add(ba, res)
+        elif res:
+            await self._free_resources(res)
+        self._push_idle(w)
+
+    # ------------------------------------------------------------------
+    # placement group bundles (2-phase commit participant)
+    # ------------------------------------------------------------------
+    async def prepare_bundle(self, pg_id: bytes, index: int,
+                             resources: dict) -> bool:
+        if resources_fit(self.resources_available, resources):
+            resources_sub(self.resources_available, resources)
+            self.bundles.setdefault(pg_id, {})[index] = dict(resources)
+            return True
+        return False
+
+    async def commit_bundle(self, pg_id: bytes, index: int) -> None:
+        res = self.bundles.get(pg_id, {}).get(index)
+        if res is not None:
+            self.bundle_available[(pg_id, index)] = dict(res)
+
+    async def return_bundle(self, pg_id: bytes, index: int) -> None:
+        res = self.bundles.get(pg_id, {}).pop(index, None)
+        if res is not None:
+            self.bundle_available.pop((pg_id, index), None)
+            await self._free_resources(res)
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    async def start_actor(self, actor_id: bytes, spec_blob: bytes,
+                          resources: dict, pg: Optional[bytes],
+                          bundle_index: int) -> dict:
+        avail = (self.bundle_available.get((pg, bundle_index))
+                 if pg is not None else self.resources_available)
+        if avail is None or not resources_fit(avail, resources):
+            raise RuntimeError("insufficient resources for actor")
+        resources_sub(avail, resources)
+        try:
+            w = self._spawn_worker()  # dedicated worker, never pooled
+            await asyncio.wait_for(w.ready.wait(),
+                                   GlobalConfig.worker_register_timeout_s)
+            w.dedicated_actor = actor_id
+            assert w.client is not None
+            await w.client.call("create_actor_local", spec_blob)
+            return {"addr": w.addr}
+        except Exception:
+            resources_add(avail, resources)
+            raise
+
+    async def kill_actor_worker(self, actor_id: bytes) -> None:
+        for w in self.workers.values():
+            if w.dedicated_actor == actor_id:
+                w.dedicated_actor = None  # suppress death report (intended)
+                w.proc.terminate()
+                return
+
+    # ------------------------------------------------------------------
+    # object store control plane (local workers call these)
+    # ------------------------------------------------------------------
+    async def store_create(self, oid: bytes, data_size: int,
+                           meta_size: int) -> str:
+        return self.store.create(ObjectID(oid), data_size, meta_size)
+
+    async def store_seal(self, oid: bytes, owner_addr=None,
+                         size: int = 0) -> None:
+        o = ObjectID(oid)
+        self.store.seal(o)
+        ev = self._seal_waiters.pop(oid, None)
+        if ev:
+            ev.set()
+        if owner_addr is not None:
+            asyncio.ensure_future(self._register_location(o, tuple(owner_addr),
+                                                          size))
+
+    async def _register_location(self, oid: ObjectID, owner_addr: Address,
+                                 size: int) -> None:
+        try:
+            client = self._peer(owner_addr)
+            await client.call("add_location", oid.binary(),
+                              self.node_id.binary(),
+                              (self.host, self.port), size)
+        except Exception as e:
+            logger.debug("add_location failed for %s: %r", oid, e)
+
+    async def store_get(self, oid: bytes) -> Optional[Tuple[str, int, int]]:
+        return self.store.get(ObjectID(oid))
+
+    async def store_release(self, oid: bytes) -> None:
+        self.store.release(ObjectID(oid))
+
+    async def store_delete(self, oid: bytes) -> None:
+        self.store.delete(ObjectID(oid))
+
+    async def store_contains(self, oid: bytes) -> int:
+        return self.store.contains(ObjectID(oid))
+
+    async def wait_seal(self, oid: bytes, timeout: float = 1.0) -> bool:
+        if self.store.contains(ObjectID(oid)) == 1:
+            return True
+        ev = self._seal_waiters.setdefault(oid, asyncio.Event())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    # --- node-to-node transfer -------------------------------------------
+    def _peer(self, addr: Address) -> RpcClient:
+        addr = tuple(addr)
+        client = self._peer_clients.get(addr)
+        if client is None:
+            client = RpcClient(addr)
+            self._peer_clients[addr] = client
+        return client
+
+    async def object_info(self, oid: bytes) -> Optional[Tuple[int, int]]:
+        got = self.store.get(ObjectID(oid))
+        if got is None:
+            return None
+        path, ds, ms = got
+        self.store.release(ObjectID(oid))
+        return ds, ms
+
+    async def fetch_chunk(self, oid: bytes, offset: int, length: int) -> bytes:
+        got = self.store.get(ObjectID(oid))
+        if got is None:
+            raise KeyError(f"object not local: {ObjectID(oid)}")
+        path, ds, ms = got
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        finally:
+            self.store.release(ObjectID(oid))
+
+    async def pull_object(self, oid: bytes, from_addr) -> bool:
+        """Fetch a remote object into the local store (idempotent)."""
+        o = ObjectID(oid)
+        if self.store.contains(o) == 1:
+            return True
+        fut = self._pulls.get(oid)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls[oid] = fut
+        try:
+            peer = self._peer(tuple(from_addr))
+            info = await peer.call("object_info", oid)
+            if info is None:
+                raise KeyError("remote no longer has object")
+            ds, ms = info
+            total = ds + ms
+            path = self.store.create(o, ds, ms)
+            chunk = GlobalConfig.object_transfer_chunk_bytes
+            with open(path, "r+b") as f:
+                off = 0
+                while off < total:
+                    n = min(chunk, total - off)
+                    data = await peer.call("fetch_chunk", oid, off, n)
+                    f.seek(off)
+                    f.write(data)
+                    off += n
+            self.store.seal(o)
+            ev = self._seal_waiters.pop(oid, None)
+            if ev:
+                ev.set()
+            fut.set_result(True)
+            return True
+        except Exception as e:
+            try:
+                self.store.delete(o)
+            except Exception:
+                pass
+            fut.set_exception(e)
+            raise
+        finally:
+            self._pulls.pop(oid, None)
+
+    async def free_objects(self, oids: list) -> None:
+        for oid in oids:
+            try:
+                self.store.delete(ObjectID(oid))
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # notifications / state
+    # ------------------------------------------------------------------
+    async def node_dead(self, node_id: bytes) -> None:
+        pass  # locations are owner-tracked; nothing node-local to clean
+
+    async def agent_stats(self) -> dict:
+        return {
+            "node_id": self.node_id.binary(),
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "num_workers": len(self.workers),
+            "num_idle": len(self.idle_workers),
+            "store_used": self.store.used(),
+            "store_capacity": self.store.capacity(),
+            "store_objects": self.store.num_objects(),
+            "store_evictions": self.store.num_evictions(),
+        }
+
+    async def ping(self) -> str:
+        return "pong"
+
+    async def shutdown_node(self) -> None:
+        self._shutdown = True
+        for w in self.workers.values():
+            if w.proc.poll() is None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+        asyncio.get_running_loop().call_later(0.2, sys.exit, 0)
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--controller", required=True, help="host:port")
+    p.add_argument("--resources", default="{}", help="JSON resource dict")
+    p.add_argument("--labels", default="{}")
+    p.add_argument("--session-dir", required=True)
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args()
+    host, port_s = args.controller.rsplit(":", 1)
+    resources = json.loads(args.resources)
+    if "CPU" not in resources:
+        resources["CPU"] = float(os.cpu_count() or 1)
+
+    async def run():
+        agent = NodeAgent((host, int(port_s)), resources, args.session_dir,
+                          json.loads(args.labels))
+        port = await agent.start(args.port)
+        print(f"AGENT_PORT={port}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

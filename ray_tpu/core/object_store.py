@@ -1,0 +1,197 @@
+"""Python binding + client for the native shared-memory object store.
+
+The node agent hosts one `LocalObjectStore` (in-process, backed by
+libraytpu_store.so — see csrc/object_store.cc, the analogue of the reference's
+in-raylet plasma store, reference: src/ray/object_manager/plasma/store_runner.cc).
+Workers use `StoreClient`, which performs control operations through the
+agent's RPC and maps object bytes directly from tmpfs for zero-copy reads
+(the reference's equivalent zero-copy path is the plasma client mmap,
+reference: src/ray/object_manager/plasma/client.cc).
+
+The native library is built on demand (first import) with the repo Makefile.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+from typing import Optional, Tuple
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.utils import get_logger
+
+logger = get_logger("object_store")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "_native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libraytpu_store.so")
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+
+
+def _build_native() -> None:
+    subprocess.run(["make", "-s"], cwd=os.path.abspath(_CSRC), check=True)
+
+
+def _load_lib() -> ctypes.CDLL:
+    if not os.path.exists(_LIB_PATH):
+        _build_native()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.store_create.restype = ctypes.c_void_p
+    lib.store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.store_destroy.argtypes = [ctypes.c_void_p]
+    lib.store_create_object.restype = ctypes.c_int
+    lib.store_create_object.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_int]
+    lib.store_seal.restype = ctypes.c_int
+    lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.store_get.restype = ctypes.c_int
+    lib.store_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+    for fn in ("store_release", "store_delete", "store_contains"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.store_pin.restype = ctypes.c_int
+    lib.store_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    for fn in ("store_used", "store_capacity", "store_num_objects",
+               "store_num_evictions"):
+        getattr(lib, fn).restype = ctypes.c_uint64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = _load_lib()
+    return _lib
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class LocalObjectStore:
+    """In-process handle to the native store (hosted by the node agent)."""
+
+    def __init__(self, shm_dir: str, capacity: int):
+        self._lib = _get_lib()
+        self._handle = self._lib.store_create(shm_dir.encode(), capacity)
+        self._dir = shm_dir
+
+    # -- lifecycle ---------------------------------------------------------
+    def create(self, oid: ObjectID, data_size: int, meta_size: int = 0) -> str:
+        buf = ctypes.create_string_buffer(4096)
+        rc = self._lib.store_create_object(
+            self._handle, oid.binary(), data_size, meta_size, buf, 4096)
+        if rc == -1:
+            raise FileExistsError(f"object exists: {oid}")
+        if rc == -2:
+            raise ObjectStoreFullError(
+                f"cannot fit {data_size + meta_size} bytes")
+        if rc != 0:
+            raise OSError(f"store create failed rc={rc}")
+        return buf.value.decode()
+
+    def seal(self, oid: ObjectID) -> None:
+        if self._lib.store_seal(self._handle, oid.binary()) != 0:
+            raise KeyError(f"seal: no such object {oid}")
+
+    def get(self, oid: ObjectID) -> Optional[Tuple[str, int, int]]:
+        """Pin + return (path, data_size, meta_size), or None if absent/unsealed."""
+        buf = ctypes.create_string_buffer(4096)
+        ds = ctypes.c_uint64()
+        ms = ctypes.c_uint64()
+        rc = self._lib.store_get(self._handle, oid.binary(), buf, 4096,
+                                 ctypes.byref(ds), ctypes.byref(ms))
+        if rc != 0:
+            return None
+        return buf.value.decode(), ds.value, ms.value
+
+    def release(self, oid: ObjectID) -> None:
+        self._lib.store_release(self._handle, oid.binary())
+
+    def delete(self, oid: ObjectID) -> None:
+        self._lib.store_delete(self._handle, oid.binary())
+
+    def contains(self, oid: ObjectID) -> int:
+        """0 absent, 1 sealed, 2 present-unsealed."""
+        return self._lib.store_contains(self._handle, oid.binary())
+
+    def pin(self, oid: ObjectID, pinned: bool = True) -> None:
+        self._lib.store_pin(self._handle, oid.binary(), 1 if pinned else 0)
+
+    # -- local data-plane helpers -----------------------------------------
+    def put_bytes(self, oid: ObjectID, data: bytes | memoryview,
+                  meta: bytes = b"") -> None:
+        path = self.create(oid, len(data), len(meta))
+        total = len(data) + len(meta)
+        if total:
+            with open(path, "r+b") as f:
+                with mmap.mmap(f.fileno(), total) as m:
+                    m[:len(data)] = data
+                    if meta:
+                        m[len(data):] = meta
+        self.seal(oid)
+
+    # -- stats -------------------------------------------------------------
+    def used(self) -> int:
+        return self._lib.store_used(self._handle)
+
+    def capacity(self) -> int:
+        return self._lib.store_capacity(self._handle)
+
+    def num_objects(self) -> int:
+        return self._lib.store_num_objects(self._handle)
+
+    def num_evictions(self) -> int:
+        return self._lib.store_num_evictions(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.store_destroy(self._handle)
+            self._handle = None
+
+
+class MappedObject:
+    """A zero-copy view of a sealed object; releases the pin on close.
+
+    ``data``/``meta`` are memoryviews into the shared mapping — valid until
+    close(). Consumers that need the bytes past close() must copy.
+    """
+
+    def __init__(self, path: str, data_size: int, meta_size: int,
+                 release_cb=None):
+        self._release_cb = release_cb
+        total = data_size + meta_size
+        if total == 0:
+            self._mm = None
+            self.data = memoryview(b"")
+            self.meta = memoryview(b"")
+        else:
+            with open(path, "rb") as f:
+                self._mm = mmap.mmap(f.fileno(), total, prot=mmap.PROT_READ)
+            view = memoryview(self._mm)
+            self.data = view[:data_size]
+            self.meta = view[data_size:total]
+
+    def close(self) -> None:
+        self.data.release()
+        self.meta.release()
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+        if self._release_cb:
+            self._release_cb()
+            self._release_cb = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,117 @@
+"""Value serialization for the object store and RPC payloads.
+
+Analogue of the reference's msgpack+pickle5 scheme (reference:
+python/ray/_private/serialization.py): cloudpickle for closures/classes,
+pickle protocol 5 with out-of-band buffers so numpy/jax host arrays are
+written into (and read from) shared memory without copies, and ObjectRefs
+inside values are serialized by reference with the contained refs reported to
+the caller for distributed refcounting (reference: borrower protocol in
+src/ray/core_worker/reference_count.cc).
+
+Wire layout of a stored object:
+  data  = pickle_bytes + padding-to-64 + buf0 + pad + buf1 + ...
+  meta  = msgpack([pickle_len, [buf_len, ...]])
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, List, Optional, Tuple
+
+import cloudpickle
+import msgpack
+
+ALIGN = 64
+
+_local = threading.local()
+
+
+def _align(n: int) -> int:
+    return (n + ALIGN - 1) // ALIGN * ALIGN
+
+
+class SerializedValue:
+    __slots__ = ("pickle_bytes", "buffers", "contained_refs")
+
+    def __init__(self, pickle_bytes: bytes, buffers: List[pickle.PickleBuffer],
+                 contained_refs: list):
+        self.pickle_bytes = pickle_bytes
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    @property
+    def total_size(self) -> int:
+        n = _align(len(self.pickle_bytes))
+        for b in self.buffers:
+            n = _align(n + len(b.raw()))
+        return n
+
+    def meta(self) -> bytes:
+        return msgpack.packb(
+            [len(self.pickle_bytes), [len(b.raw()) for b in self.buffers]])
+
+    def write_into(self, mem: memoryview) -> None:
+        off = 0
+        pb = self.pickle_bytes
+        mem[:len(pb)] = pb
+        off = _align(len(pb))
+        for b in self.buffers:
+            raw = b.raw()
+            mem[off:off + len(raw)] = raw
+            off = _align(off + len(raw))
+
+    def to_bytes(self) -> bytes:
+        """Contiguous data section (for inline/RPC transport)."""
+        out = bytearray(self.total_size)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value: Any) -> SerializedValue:
+    """Serialize; collects out-of-band buffers and contained ObjectRefs."""
+    buffers: List[pickle.PickleBuffer] = []
+    prev = getattr(_local, "refs", None)
+    _local.refs = []
+    try:
+        pb = cloudpickle.dumps(value, protocol=5,
+                               buffer_callback=buffers.append)
+        refs = _local.refs
+    finally:
+        _local.refs = prev
+    return SerializedValue(pb, buffers, refs)
+
+
+def note_contained_ref(ref: Any) -> None:
+    """Called from ObjectRef.__reduce__ while a serialize() is in flight."""
+    refs = getattr(_local, "refs", None)
+    if refs is not None:
+        refs.append(ref)
+
+
+def deserialize(data: memoryview | bytes, meta: bytes) -> Any:
+    pickle_len, buf_lens = msgpack.unpackb(meta)
+    mv = memoryview(data)
+    off = _align(pickle_len)
+    bufs = []
+    for n in buf_lens:
+        bufs.append(mv[off:off + n])
+        off = _align(off + n)
+    return pickle.loads(mv[:pickle_len], buffers=bufs)
+
+
+def serialize_error(exc: BaseException) -> SerializedValue:
+    try:
+        return serialize(exc)
+    except Exception:
+        return serialize(RuntimeError(repr(exc)))
+
+
+# --- helpers for inline (non-store) transport ------------------------------
+
+def pack_inline(sv: SerializedValue) -> Tuple[bytes, bytes]:
+    return sv.to_bytes(), sv.meta()
+
+
+def unpack_inline(data: bytes, meta: bytes) -> Any:
+    return deserialize(data, meta)

@@ -1,0 +1,39 @@
+"""Worker process entry point (spawned by the node agent).
+
+Analogue of the reference's default_worker.py (reference:
+python/ray/_private/workers/default_worker.py): connects the CoreWorker in
+worker mode and serves pushed tasks until the parent agent disappears.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def main() -> None:
+    agent_host, agent_port = os.environ["RAY_TPU_AGENT_ADDR"].rsplit(":", 1)
+    ctrl_host, ctrl_port = os.environ["RAY_TPU_CONTROLLER_ADDR"].rsplit(":", 1)
+    session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp")
+
+    from ray_tpu.utils.logging import configure
+    configure("worker", session_dir)
+
+    from ray_tpu.core.core_worker import CoreWorker
+
+    cw = CoreWorker("worker", (agent_host, int(agent_port)),
+                    (ctrl_host, int(ctrl_port)), session_dir)
+    parent = os.getppid()
+    try:
+        while True:
+            time.sleep(1.0)
+            if os.getppid() != parent:  # agent died; fate-share
+                break
+    finally:
+        cw.shutdown()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()
