@@ -189,6 +189,8 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     cw = _cw()
     cw._run(cw.controller.call("kill_actor", actor.actor_id.binary(),
                                no_restart)).result()
+    if no_restart:
+        cw.release_actor_arg_refs(actor.actor_id.binary())
 
 
 def get_actor(name: str) -> ActorHandle:

@@ -51,7 +51,7 @@ PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 
 class ObjectEntry:
     __slots__ = ("state", "inline", "locations", "size", "local_refs",
-                 "borrow_refs", "creating_task", "event", "error")
+                 "borrow_refs", "creating_task", "event", "error", "contained")
 
     def __init__(self):
         self.state = PENDING
@@ -63,6 +63,9 @@ class ObjectEntry:
         self.creating_task: Optional[TaskSpec] = None
         self.event: Optional[asyncio.Event] = None
         self.error: Optional[BaseException] = None
+        # Refs contained inside this object's value (borrowed on put, so the
+        # nested objects outlive this one; dropped when this object is freed).
+        self.contained: list = []
 
 
 class CoreWorker:
@@ -98,8 +101,19 @@ class CoreWorker:
         # actor_id -> (addr, client, incarnation)
         self._actor_clients: Dict[bytes, Tuple[Address, RpcClient, int]] = {}
         # Send-side seqnos are assigned per (actor, incarnation) at push time
-        # so a restarted actor (which expects 0 again) stays in sync.
+        # so a restarted actor (which expects 0 again) stays in sync. The
+        # last-known incarnation lives in its own map (not the client cache,
+        # which is dropped on transient connection errors) so a reconnect to
+        # the SAME incarnation never resets the seqno stream.
         self._actor_seq_out: Dict[bytes, int] = {}
+        self._actor_incarnation: Dict[bytes, int] = {}
+        # task_id -> ObjectRefs held for that task's args (incl. refs
+        # contained inside inline values and promoted big args).
+        self._task_arg_refs: Dict[bytes, List[ObjectRef]] = {}
+        # actor_id -> ObjectRefs held for the actor's constructor args;
+        # pinned for the actor's lifetime (restarts re-resolve them),
+        # released when the actor is killed or observed dead.
+        self._actor_arg_refs: Dict[bytes, List[ObjectRef]] = {}
         self._next_put_index = 0
 
         self._run(self._async_init()).result()
@@ -263,6 +277,16 @@ class CoreWorker:
                 await peer.call("free_objects", [oid])
             except Exception:
                 pass
+        # Drop the borrows this object held on its contained refs.
+        for r in e.contained:
+            try:
+                if self._is_self_owned(r):
+                    await self.remove_borrow(r.binary())
+                else:
+                    await self._notify_remove_borrow(tuple(r.owner_addr),
+                                                     r.binary())
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------
     # core-worker RPC service (called by agents/other workers)
@@ -278,9 +302,9 @@ class CoreWorker:
         except asyncio.TimeoutError:
             return {"status": "pending"}
         if e.state == ERROR:
-            return {"status": "error",
-                    "error": serialization.serialize(e.error).to_bytes(),
-                    "error_meta": serialization.serialize(e.error).meta()}
+            sv = serialization.serialize(e.error)
+            return {"status": "error", "error": sv.to_bytes(),
+                    "error_meta": sv.meta()}
         if e.inline is not None:
             return {"status": "inline", "data": e.inline[0],
                     "meta": e.inline[1]}
@@ -304,6 +328,7 @@ class CoreWorker:
     async def _do_put(self, oid: bytes, sv) -> None:
         e = self._entry(oid, create=True)
         e.creating_task = None
+        e.contained = list(sv.contained_refs)
         for r in sv.contained_refs:
             await self.add_borrow(r.binary()) if self._is_self_owned(r) else \
                 await self._notify_add_borrow(tuple(r.owner_addr), r.binary())
@@ -492,27 +517,36 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # task submission (owner side)
     # ------------------------------------------------------------------
-    def _serialize_args(self, args: tuple, kwargs: dict) -> list:
-        # args encoded positionally; kwargs appended as ("k", name, *wire)
+    def _serialize_args(self, args: tuple, kwargs: dict,
+                        held: Optional[List[ObjectRef]] = None) -> list:
+        # args encoded positionally; kwargs appended as ("k", name, *wire).
+        # Every ref pinned on behalf of the args (top-level, contained in
+        # inline values, or promoted big args) is appended to `held` so the
+        # submit path can release them all when the task completes.
+        if held is None:
+            held = []
         out = []
         for a in args:
-            out.append(("p",) + self._wire_value(a))
+            out.append(("p",) + self._wire_value(a, held))
         for k, v in kwargs.items():
-            out.append(("k", k) + self._wire_value(v))
+            out.append(("k", k) + self._wire_value(v, held))
         return out
 
-    def _wire_value(self, v: Any) -> tuple:
+    def _wire_value(self, v: Any, held: List[ObjectRef]) -> tuple:
         if isinstance(v, ObjectRef):
             self.add_local_ref(v)  # held until task completes
+            held.append(v)
             return ("r", v.binary(), v.owner_addr or self.address)
         sv = serialization.serialize(v)
         for r in sv.contained_refs:
             self.add_local_ref(r)
+            held.append(r)
         if sv.total_size > GlobalConfig.max_direct_call_object_size:
             # Promote big args to the store under a fresh put id.
             oid = ObjectID.from_put()
             ref = ObjectRef(oid, self.address)
             self.add_local_ref(ref)
+            held.append(ref)
             self._run(self._do_put(oid.binary(), sv)).result()
             return ("r", oid.binary(), self.address)
         return ("v", sv.to_bytes(), sv.meta())
@@ -523,11 +557,12 @@ class CoreWorker:
                     scheduling_strategy=None, name: str = "") -> List[ObjectRef]:
         func_id = self._export_function(func)
         task_id = TaskID.random()
+        held: List[ObjectRef] = []
         spec = TaskSpec(
             task_id=task_id.binary(),
             name=name or getattr(func, "__name__", "task"),
             func_id=func_id,
-            args=self._serialize_args(args, kwargs),
+            args=self._serialize_args(args, kwargs, held),
             num_returns=num_returns,
             resources=resources or {"CPU": 1.0},
             owner_addr=self.address,
@@ -537,6 +572,7 @@ class CoreWorker:
             pg_bundle_index=pg_bundle_index,
             scheduling_strategy=scheduling_strategy,
         )
+        self._task_arg_refs[task_id.binary()] = held
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(task_id, i)
@@ -556,6 +592,7 @@ class CoreWorker:
                 oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
                 self._mark_error(oid.binary(), e if isinstance(e, Exception)
                                  else WorkerCrashedError(repr(e)))
+            self._release_arg_refs(spec)
 
     async def _submit_with_retries(self, spec: TaskSpec) -> None:
         attempts = spec.max_retries + 1
@@ -599,13 +636,13 @@ class CoreWorker:
             pass
 
     def _release_arg_refs(self, spec: TaskSpec) -> None:
-        for a in spec.args:
-            if a[0] == "r":
-                ref = ObjectRef(ObjectID(a[1]), tuple(a[2]))
-                self.remove_local_ref(ref)
-            elif a[0] == "k" and a[2] == "r":
-                ref = ObjectRef(ObjectID(a[3]), tuple(a[4]))
-                self.remove_local_ref(ref)
+        for ref in self._task_arg_refs.pop(spec.task_id, ()):
+            self.remove_local_ref(ref)
+
+    def release_actor_arg_refs(self, actor_id: bytes) -> None:
+        """Drop the pins on an actor's constructor args (kill / death)."""
+        for ref in self._actor_arg_refs.pop(actor_id, ()):
+            self.remove_local_ref(ref)
 
     def _process_task_reply(self, spec: TaskSpec, reply: dict) -> None:
         if reply.get("error") is not None:
@@ -645,12 +682,14 @@ class CoreWorker:
                      resources: Optional[dict] = None, placement_group=None,
                      pg_bundle_index: int = -1) -> ActorHandle:
         actor_id = ActorID.random()
+        held: List[ObjectRef] = []
         creation = {
             "cls_blob": cloudpickle.dumps(cls),
-            "args": self._serialize_args(args, kwargs),
+            "args": self._serialize_args(args, kwargs, held),
             "actor_id": actor_id.binary(),
             "max_restarts": max_restarts,
         }
+        self._actor_arg_refs[actor_id.binary()] = held
         spec_blob = cloudpickle.dumps(creation)
         placement = ((placement_group, pg_bundle_index)
                      if placement_group is not None else None)
@@ -666,11 +705,12 @@ class CoreWorker:
                           kwargs, *, num_returns: int = 1) -> ObjectRef:
         actor_id = handle.actor_id.binary()
         task_id = TaskID.random()
+        held: List[ObjectRef] = []
         spec = TaskSpec(
             task_id=task_id.binary(),
             name=f"{handle._name}.{method}",
             func_id=b"",
-            args=self._serialize_args(args, kwargs),
+            args=self._serialize_args(args, kwargs, held),
             num_returns=num_returns,
             resources={},
             owner_addr=self.address,
@@ -681,6 +721,7 @@ class CoreWorker:
             caller_id=self.worker_id.binary(),
             max_retries=handle._max_task_retries,
         )
+        self._task_arg_refs[task_id.binary()] = held
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(task_id, i)
@@ -699,6 +740,7 @@ class CoreWorker:
                 oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
                 self._mark_error(oid.binary(), e if isinstance(e, Exception)
                                  else WorkerCrashedError(repr(e)))
+            self._release_arg_refs(spec)
 
     async def _actor_client(self, actor_id: bytes,
                             refresh: bool = False) -> RpcClient:
@@ -708,15 +750,16 @@ class CoreWorker:
         info = await self.controller.call("wait_actor_ready", actor_id)
         if info["state"] != "ALIVE":
             from ray_tpu.core.common import ActorDiedError
+            self.release_actor_arg_refs(actor_id)
             raise ActorDiedError(
                 f"actor is {info['state']}: {info.get('death_reason', '')}")
         addr = tuple(info["addr"])
         incarnation = info.get("incarnation", 0)
-        prev = self._actor_clients.get(actor_id)
-        if prev is None or prev[2] != incarnation:
+        if self._actor_incarnation.get(actor_id) != incarnation:
             # New incarnation: the restarted worker expects seqno 0 from every
             # caller again (its ordering state died with the old process).
             self._actor_seq_out[actor_id] = 0
+            self._actor_incarnation[actor_id] = incarnation
         client = RpcClient(addr, max_retries=0)
         self._actor_clients[actor_id] = (addr, client, incarnation)
         return client

@@ -30,11 +30,33 @@ _CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
 
 
 def _build_native() -> None:
-    subprocess.run(["make", "-s"], cwd=os.path.abspath(_CSRC), check=True)
+    # Serialize concurrent builds (parallel agents/test sessions on a fresh
+    # clone) so no process CDLLs a half-written .so.
+    import fcntl
+    os.makedirs(_NATIVE_DIR, exist_ok=True)
+    with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if _lib_stale():
+            subprocess.run(["make", "-s"], cwd=os.path.abspath(_CSRC),
+                           check=True)
+
+
+def _lib_stale() -> bool:
+    """Rebuild when absent or older than any csrc source (the .so is a build
+    artifact, never committed — see .gitignore)."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    built = os.path.getmtime(_LIB_PATH)
+    csrc = os.path.abspath(_CSRC)
+    for name in os.listdir(csrc):
+        if name.endswith((".cc", ".h")) or name == "Makefile":
+            if os.path.getmtime(os.path.join(csrc, name)) > built:
+                return True
+    return False
 
 
 def _load_lib() -> ctypes.CDLL:
-    if not os.path.exists(_LIB_PATH):
+    if _lib_stale():
         _build_native()
     lib = ctypes.CDLL(_LIB_PATH)
     lib.store_create.restype = ctypes.c_void_p

@@ -10,19 +10,27 @@ probabilistic request failure injection via the ``testing_rpc_failure`` config
 flag (format "method=prob,method2=prob").
 
 Wire format (little-endian u32 length prefix, msgpack body):
-  request:  [seqno, method, args_bytes]      (args pickled by caller layer)
+  request:  [seqno, method, args_bytes, request_id?]
   response: [seqno, status, payload_bytes]   status: 0 ok, 1 app error
 Payloads are opaque bytes; serialization policy lives in the caller layer so
 zero-copy buffers can bypass msgpack.
+
+Retry safety: a retried call re-sends the SAME request_id; the server keeps
+an LRU cache of completed responses keyed by request_id and replays the
+cached response instead of re-executing the handler. This makes retries of
+non-idempotent methods (request_lease, store_create, create_actor)
+exactly-once per server process — a lost reply never double-executes.
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
 import pickle
 import random
 import struct
 import time
+from collections import OrderedDict
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 import msgpack
@@ -81,11 +89,19 @@ Handler = Callable[..., Awaitable[Any]]
 class RpcServer:
     """Serves registered async handlers over TCP and/or a unix socket."""
 
+    # Completed-response cache for retry dedup (per server process). Bodies
+    # above the byte cap are not cached (bulk reads like kv_get are
+    # idempotent; re-executing them on a rare lost reply beats pinning MBs).
+    _DEDUP_CAP = 4096
+    _DEDUP_MAX_BODY = 256 * 1024
+
     def __init__(self, name: str = "server"):
         self._name = name
         self._handlers: Dict[str, Handler] = {}
         self._servers: list[asyncio.AbstractServer] = []
         self.port: Optional[int] = None
+        # request_id -> Future[(status, payload)] (in-flight or completed)
+        self._dedup: "OrderedDict[str, asyncio.Future]" = OrderedDict()
 
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
@@ -123,33 +139,57 @@ class RpcServer:
         try:
             while True:
                 try:
-                    seqno, method, payload = await _read_msg(reader)
+                    msg = await _read_msg(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
+                seqno, method, payload = msg[0], msg[1], msg[2]
+                rid = msg[3] if len(msg) > 3 else None
                 asyncio.ensure_future(
-                    self._dispatch(seqno, method, payload, writer))
+                    self._dispatch(seqno, method, payload, writer, rid))
         finally:
             writer.close()
 
-    async def _dispatch(self, seqno: int, method: str, payload: bytes,
-                        writer: asyncio.StreamWriter) -> None:
-        delay_us = GlobalConfig.testing_event_loop_delay_us
-        if delay_us:
-            await asyncio.sleep(delay_us / 1e6)
+    async def _execute(self, method: str, payload: bytes) -> Tuple[int, bytes]:
         handler = self._handlers.get(method)
         try:
             if handler is None:
                 raise RpcError(f"[{self._name}] no such method: {method}")
             args, kwargs = pickle.loads(payload) if payload else ((), {})
             result = await handler(*args, **kwargs)
-            out = [seqno, 0, pickle.dumps(result, protocol=5)]
+            return 0, pickle.dumps(result, protocol=5)
         except BaseException as e:  # noqa: BLE001 — errors cross the wire
             try:
-                out = [seqno, 1, pickle.dumps(e, protocol=5)]
+                return 1, pickle.dumps(e, protocol=5)
             except Exception:
-                out = [seqno, 1, pickle.dumps(RpcError(repr(e)), protocol=5)]
+                return 1, pickle.dumps(RpcError(repr(e)), protocol=5)
+
+    async def _dispatch(self, seqno: int, method: str, payload: bytes,
+                        writer: asyncio.StreamWriter,
+                        rid: Optional[str] = None) -> None:
+        delay_us = GlobalConfig.testing_event_loop_delay_us
+        if delay_us:
+            await asyncio.sleep(delay_us / 1e6)
+        if rid is None:
+            status, body = await self._execute(method, payload)
+        else:
+            fut = self._dedup.get(rid)
+            if fut is not None:
+                # Duplicate (client retry): replay / await the first result
+                # instead of re-executing the handler.
+                self._dedup.move_to_end(rid)
+                status, body = await asyncio.shield(fut)
+            else:
+                fut = asyncio.get_running_loop().create_future()
+                self._dedup[rid] = fut
+                while len(self._dedup) > self._DEDUP_CAP:
+                    self._dedup.popitem(last=False)
+                status, body = await self._execute(method, payload)
+                if not fut.done():
+                    fut.set_result((status, body))
+                if len(body) > self._DEDUP_MAX_BODY:
+                    self._dedup.pop(rid, None)
         try:
-            _write_msg(writer, out)
+            _write_msg(writer, [seqno, status, body])
             await writer.drain()
         except (ConnectionResetError, BrokenPipeError):
             pass
@@ -174,6 +214,8 @@ class RpcClient:
         self._recv_task: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
         self._chaos = _chaos_table()
+        self._rid_prefix = os.urandom(6).hex()
+        self._rid_counter = 0
 
     async def _ensure_connected(self) -> None:
         if self._writer is not None and not self._writer.is_closing():
@@ -221,6 +263,12 @@ class RpcClient:
     async def call(self, method: str, *args: Any, **kwargs: Any) -> Any:
         prob = self._chaos.get(method) or self._chaos.get("*")
         payload = pickle.dumps((args, kwargs), protocol=5)
+        # Retriable calls carry a stable request id so the server can dedup
+        # re-sends of a request that already executed (reply lost).
+        rid: Optional[str] = None
+        if self._max_retries > 0:
+            self._rid_counter += 1
+            rid = f"{self._rid_prefix}:{self._rid_counter}"
         delay = 0.01
         last: Optional[Exception] = None
         for attempt in range(self._max_retries + 1):
@@ -236,7 +284,9 @@ class RpcClient:
                 seqno = self._seqno
                 fut: asyncio.Future = asyncio.get_running_loop().create_future()
                 self._pending[seqno] = fut
-                _write_msg(self._writer, [seqno, method, payload])
+                msg = [seqno, method, payload] if rid is None else \
+                    [seqno, method, payload, rid]
+                _write_msg(self._writer, msg)
                 await self._writer.drain()
                 if self._timeout:
                     return await asyncio.wait_for(fut, self._timeout)

@@ -24,6 +24,11 @@ def main() -> None:
 
     cw = CoreWorker("worker", (agent_host, int(agent_port)),
                     (ctrl_host, int(ctrl_port)), session_dir)
+    # Bind the public API to this worker's CoreWorker so user task code can
+    # call ray_tpu.get/put/remote inside workers (reference analogue:
+    # python/ray/_private/worker.py global worker in WORKER mode).
+    import ray_tpu.api as _api
+    _api._core_worker = cw
     parent = os.getppid()
     try:
         while True:

@@ -202,3 +202,44 @@ def test_task_retry_after_worker_crash(cluster):
     finally:
         if os.path.exists(marker):
             os.remove(marker)
+
+
+def test_contained_arg_refs_released(cluster):
+    """Refs nested inside an inline task arg are released after the task
+    completes — they must not pin the owned object forever."""
+    from ray_tpu.core.ref import get_core_worker
+
+    cw = get_core_worker()
+
+    @ray_tpu.remote
+    def read(d):
+        return ray_tpu.get(d["ref"]) + 1
+
+    inner = ray_tpu.put(41)
+    k = inner.binary()
+    assert ray_tpu.get(read.remote({"ref": inner})) == 42
+    assert k in cw.objects
+    del inner
+    deadline = time.time() + 10
+    while time.time() < deadline and k in cw.objects:
+        time.sleep(0.1)
+    assert k not in cw.objects, "contained arg ref leaked"
+
+
+def test_contained_put_refs_released(cluster):
+    """Borrows taken by put() on contained refs are dropped when the outer
+    object is freed."""
+    from ray_tpu.core.ref import get_core_worker
+
+    cw = get_core_worker()
+    inner = ray_tpu.put("nested")
+    outer = ray_tpu.put({"ref": inner})
+    k = inner.binary()
+    del inner  # only the outer object's borrow keeps it alive
+    time.sleep(0.3)
+    assert k in cw.objects, "borrow by containing object should pin it"
+    del outer
+    deadline = time.time() + 10
+    while time.time() < deadline and k in cw.objects:
+        time.sleep(0.1)
+    assert k not in cw.objects, "contained put borrow leaked"
