@@ -1,0 +1,119 @@
+"""TPU accelerator manager: chip discovery, visibility, slice labels.
+
+Analogue of the reference's TPU accelerator manager (reference:
+python/ray/_private/accelerators/tpu.py:199 TPUAcceleratorManager — chip
+discovery via TPU_CHIPS_PER_HOST_BOUNDS / /dev devices, TPU_VISIBLE_CHIPS
+env for workers, slice-name node label :564, pod-type resources), rebuilt
+TPU-first: the node agent calls into this module at startup to advertise
+``TPU`` as a first-class scheduler resource plus slice/topology labels, and
+at actor spawn to pin specific chips to a worker process.
+
+Design departures from the reference: no GCE metadata server calls (works
+in any container), and chip accounting lives in the node agent's resource
+vectors rather than a bolted-on custom-resource string.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, List, Optional
+
+from ray_tpu.utils.config import GlobalConfig
+
+# Node label keys (reference: tpu.py RAY_NODE_TPU_SLICE_NAME_KEY etc.)
+TPU_SLICE_NAME_LABEL = "ray_tpu.io/tpu-slice-name"
+TPU_ACCELERATOR_TYPE_LABEL = "ray_tpu.io/tpu-accelerator-type"
+TPU_WORKER_ID_LABEL = "ray_tpu.io/tpu-worker-id"
+TPU_TOPOLOGY_LABEL = "ray_tpu.io/tpu-topology"
+
+
+def _chips_from_bounds(bounds: str) -> Optional[int]:
+    """Parse '2,2,1'-style TPU_CHIPS_PER_HOST_BOUNDS into a chip count."""
+    try:
+        dims = [int(x) for x in bounds.split(",") if x.strip()]
+        n = 1
+        for d in dims:
+            n *= d
+        return n if n > 0 else None
+    except ValueError:
+        return None
+
+
+def num_tpu_chips() -> int:
+    """Detect the number of TPU chips attached to this host.
+
+    Priority: explicit config flag (tests / operator override) >
+    TPU_CHIPS_PER_HOST_BOUNDS env (set by the TPU VM runtime) >
+    /dev/accel* or /dev/vfio device files > none.
+    """
+    if GlobalConfig.tpu_chips_per_host > 0:
+        return int(GlobalConfig.tpu_chips_per_host)
+    bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
+    if bounds:
+        n = _chips_from_bounds(bounds)
+        if n:
+            return n
+    accel = glob.glob("/dev/accel*")
+    if accel:
+        return len(accel)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    return 0
+
+
+def visible_chip_ids() -> List[int]:
+    """Chip ids this agent may hand to workers (tpu_visible_chips filter)."""
+    n = num_tpu_chips()
+    spec = GlobalConfig.tpu_visible_chips.strip()
+    if spec:
+        ids = sorted({int(x) for x in spec.split(",") if x.strip()})
+        return [i for i in ids if 0 <= i < max(n, max(ids) + 1)]
+    return list(range(n))
+
+
+def accelerator_type() -> str:
+    """e.g. 'v5e-16' — from TPU VM env, else empty."""
+    t = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    return t if re.match(r"^v\d", t) else t
+
+
+def slice_name() -> str:
+    """Multi-host slice identity (gang scheduling key)."""
+    return os.environ.get("TPU_NAME", os.environ.get("TPU_WORKER_HOSTNAMES",
+                                                     ""))
+
+
+def tpu_worker_id() -> int:
+    try:
+        return int(os.environ.get("TPU_WORKER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def node_labels() -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    if accelerator_type():
+        labels[TPU_ACCELERATOR_TYPE_LABEL] = accelerator_type()
+    if slice_name():
+        labels[TPU_SLICE_NAME_LABEL] = slice_name()
+        labels[TPU_WORKER_ID_LABEL] = str(tpu_worker_id())
+    topo = os.environ.get("TPU_TOPOLOGY", "")
+    if topo:
+        labels[TPU_TOPOLOGY_LABEL] = topo
+    return labels
+
+
+def worker_env_for_chips(chip_ids: List[int]) -> Dict[str, str]:
+    """Env vars that scope a spawned worker process to specific chips
+    (reference: tpu.py set_current_process_visible_accelerator_ids →
+    TPU_VISIBLE_CHIPS)."""
+    ids = ",".join(str(i) for i in chip_ids)
+    return {
+        "TPU_VISIBLE_CHIPS": ids,
+        # One process per assigned chip group; single-host bounds.
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,{len(chip_ids)},1",
+        "TPU_PROCESS_BOUNDS": "1,1,1",
+    }

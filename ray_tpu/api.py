@@ -135,7 +135,8 @@ class ActorClass:
             # small node isn't starved of task leases by resident actors.
             resources=_resources_from_opts(opts, default_cpu=0.0),
             placement_group=_pg_id(opts.get("placement_group")),
-            pg_bundle_index=opts.get("placement_group_bundle_index", -1))
+            pg_bundle_index=opts.get("placement_group_bundle_index", -1),
+            runtime_env=opts.get("runtime_env"))
 
     def options(self, **opts):
         merged = dict(self._opts)

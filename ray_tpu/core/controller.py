@@ -49,7 +49,8 @@ class NodeEntry:
 class ActorEntry:
     def __init__(self, actor_id: bytes, spec_blob: bytes, name: str,
                  max_restarts: int, resources: Dict[str, float],
-                 placement: Optional[Tuple[bytes, int]]):
+                 placement: Optional[Tuple[bytes, int]],
+                 runtime_env: Optional[dict] = None):
         self.actor_id = actor_id
         self.spec_blob = spec_blob
         self.name = name
@@ -57,6 +58,7 @@ class ActorEntry:
         self.restarts_used = 0
         self.resources = resources
         self.placement = placement
+        self.runtime_env = runtime_env or {}
         self.state = ActorState.PENDING
         self.addr: Optional[Address] = None
         self.node_id: Optional[bytes] = None
@@ -214,13 +216,15 @@ class Controller:
     # ------------------------------------------------------------------
     async def create_actor(self, actor_id: bytes, spec_blob: bytes, name: str,
                            max_restarts: int, resources: dict,
-                           placement=None, detached: bool = False) -> dict:
+                           placement=None, detached: bool = False,
+                           runtime_env: Optional[dict] = None) -> dict:
         if name:
             if name in self.named_actors:
                 raise ValueError(f"actor name already taken: {name!r}")
             self.named_actors[name] = actor_id
         entry = ActorEntry(actor_id, spec_blob, name, max_restarts, resources,
-                           tuple(placement) if placement else None)
+                           tuple(placement) if placement else None,
+                           runtime_env)
         self.actors[actor_id] = entry
         asyncio.ensure_future(self._schedule_actor(entry))
         return {"actor_id": actor_id}
@@ -242,7 +246,8 @@ class Controller:
                         "start_actor", entry.actor_id, entry.spec_blob,
                         entry.resources,
                         entry.placement[0] if entry.placement else None,
-                        entry.placement[1] if entry.placement else -1)
+                        entry.placement[1] if entry.placement else -1,
+                        env_vars=entry.runtime_env.get("env_vars"))
                     entry.addr = tuple(reply["addr"])
                     entry.node_id = node.node_id
                     entry.state = ActorState.ALIVE

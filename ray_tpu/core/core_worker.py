@@ -680,7 +680,8 @@ class CoreWorker:
     def create_actor(self, cls, args, kwargs, *, name: str = "",
                      max_restarts: int = 0, max_task_retries: int = 0,
                      resources: Optional[dict] = None, placement_group=None,
-                     pg_bundle_index: int = -1) -> ActorHandle:
+                     pg_bundle_index: int = -1,
+                     runtime_env: Optional[dict] = None) -> ActorHandle:
         actor_id = ActorID.random()
         held: List[ObjectRef] = []
         creation = {
@@ -695,7 +696,8 @@ class CoreWorker:
                      if placement_group is not None else None)
         self._run(self.controller.call(
             "create_actor", actor_id.binary(), spec_blob, name, max_restarts,
-            resources or {"CPU": 1.0}, placement)).result()
+            resources or {"CPU": 1.0}, placement,
+            runtime_env=runtime_env)).result()
         method_names = [m for m in dir(cls)
                         if not m.startswith("_") and callable(getattr(cls, m))]
         return ActorHandle(actor_id, name or cls.__name__, method_names,

@@ -79,8 +79,25 @@ class NodeAgent:
         self.controller = RpcClient(controller_addr)
         self.host = host
         self.resources_total = dict(resources)
-        self.resources_available = dict(resources)
-        self.labels = labels or {}
+        self.labels = dict(labels or {})
+        # TPU accelerator manager: advertise chips as a first-class resource
+        # + slice/topology labels (reference: accelerators/tpu.py:199,564).
+        from ray_tpu import accelerators
+        self.tpu_free_chips: List[int] = []
+        self.tpu_assigned: Dict[bytes, List[int]] = {}  # actor_id -> chips
+        # actor_id -> (resources, pg, bundle_index) for release on death
+        self.actor_allocations: Dict[bytes, tuple] = {}
+        if "TPU" not in self.resources_total:
+            chips = accelerators.visible_chip_ids()
+            if chips:
+                self.resources_total["TPU"] = float(len(chips))
+                self.tpu_free_chips = list(chips)
+        else:
+            self.tpu_free_chips = list(range(int(
+                self.resources_total["TPU"])))
+        for k, v in accelerators.node_labels().items():
+            self.labels.setdefault(k, v)
+        self.resources_available = dict(self.resources_total)
         self.session_dir = session_dir
         self.port: Optional[int] = None
 
@@ -163,12 +180,28 @@ class NodeAgent:
         if w.dedicated_actor is not None:
             actor_id = w.dedicated_actor
             w.dedicated_actor = None
+            self._release_actor_allocation(actor_id)
             try:
                 await self.controller.call(
                     "report_actor_death", actor_id,
                     f"worker process exited with code {w.proc.returncode}")
             except Exception:
                 pass
+
+    def _release_actor_allocation(self, actor_id: bytes) -> None:
+        chips = self.tpu_assigned.pop(actor_id, None)
+        if chips:
+            self.tpu_free_chips.extend(chips)
+            self.tpu_free_chips.sort()
+        alloc = self.actor_allocations.pop(actor_id, None)
+        if alloc:
+            res, pg, bundle_index = alloc
+            if pg is not None:
+                ba = self.bundle_available.get((pg, bundle_index))
+                if ba is not None:
+                    resources_add(ba, res)
+            elif res:
+                resources_add(self.resources_available, res)
 
     async def _free_resources(self, res: Dict[str, float]) -> None:
         async with self._resource_cv:
@@ -178,13 +211,25 @@ class NodeAgent:
     # ------------------------------------------------------------------
     # worker pool (reference: src/ray/raylet/worker_pool.cc)
     # ------------------------------------------------------------------
-    def _spawn_worker(self) -> WorkerProc:
+    def _spawn_worker(self, extra_env: Optional[Dict[str, str]] = None
+                      ) -> WorkerProc:
         env = dict(os.environ)
         env["RAY_TPU_AGENT_ADDR"] = f"{self.host}:{self.port}"
         env["RAY_TPU_CONTROLLER_ADDR"] = \
             f"{self.controller_addr[0]}:{self.controller_addr[1]}"
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if extra_env:
+            # runtime_env env_vars (reference: runtime_env plugin env_vars)
+            # must land before the interpreter starts: JAX/XLA read
+            # JAX_PLATFORMS/XLA_FLAGS/TPU_VISIBLE_CHIPS at first import.
+            # A value of None UNSETS the var (needed to suppress inherited
+            # PJRT plugin hooks in subordinate JAX processes).
+            for k, v in extra_env.items():
+                if v is None:
+                    env.pop(str(k), None)
+                else:
+                    env[str(k)] = str(v)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env, cwd=os.getcwd())
@@ -320,28 +365,52 @@ class NodeAgent:
     # ------------------------------------------------------------------
     async def start_actor(self, actor_id: bytes, spec_blob: bytes,
                           resources: dict, pg: Optional[bytes],
-                          bundle_index: int) -> dict:
+                          bundle_index: int,
+                          env_vars: Optional[Dict[str, str]] = None) -> dict:
         avail = (self.bundle_available.get((pg, bundle_index))
                  if pg is not None else self.resources_available)
         if avail is None or not resources_fit(avail, resources):
             raise RuntimeError("insufficient resources for actor")
         resources_sub(avail, resources)
+        # Pin specific TPU chips to this worker (TPU_VISIBLE_CHIPS).
+        chips: List[int] = []
+        n_tpu = int(resources.get("TPU", 0))
+        if n_tpu > 0:
+            if len(self.tpu_free_chips) < n_tpu:
+                resources_add(avail, resources)
+                raise RuntimeError("insufficient TPU chips for actor")
+            chips = self.tpu_free_chips[:n_tpu]
+            del self.tpu_free_chips[:n_tpu]
+            from ray_tpu import accelerators
+            env_vars = dict(env_vars or {})
+            # Explicit user pinning wins over automatic assignment.
+            for k, v in accelerators.worker_env_for_chips(chips).items():
+                env_vars.setdefault(k, v)
         try:
-            w = self._spawn_worker()  # dedicated worker, never pooled
+            w = self._spawn_worker(env_vars)  # dedicated worker, never pooled
             await asyncio.wait_for(w.ready.wait(),
                                    GlobalConfig.worker_register_timeout_s)
             w.dedicated_actor = actor_id
+            if chips:
+                self.tpu_assigned[actor_id] = chips
+            self.actor_allocations[actor_id] = (dict(resources), pg,
+                                                bundle_index)
             assert w.client is not None
             await w.client.call("create_actor_local", spec_blob)
             return {"addr": w.addr}
         except Exception:
             resources_add(avail, resources)
+            if chips:
+                self.tpu_free_chips.extend(chips)
+                self.tpu_free_chips.sort()
+                self.tpu_assigned.pop(actor_id, None)
             raise
 
     async def kill_actor_worker(self, actor_id: bytes) -> None:
         for w in self.workers.values():
             if w.dedicated_actor == actor_id:
                 w.dedicated_actor = None  # suppress death report (intended)
+                self._release_actor_allocation(actor_id)
                 w.proc.terminate()
                 return
 

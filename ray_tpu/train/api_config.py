@@ -1,0 +1,71 @@
+"""Train configuration dataclasses.
+
+Analogue of the reference's AIR/Train configs (reference: python/ray/air/
+config.py ScalingConfig/RunConfig/FailureConfig/CheckpointConfig and
+python/ray/train/v2/api/config.py — incl. use_tpu/topology at :89-90),
+slimmed to the TPU-first surface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers and what each one needs.
+
+    num_workers: one JAX process per worker (usually one per TPU host, with
+      all the host's chips, or one per chip with chips_per_worker=1).
+    use_tpu: request TPU chips from the scheduler.
+    chips_per_worker: TPU chips pinned to each worker (TPU_VISIBLE_CHIPS).
+    resources_per_worker: extra scheduler resources per worker.
+    placement_strategy: bundle placement (PACK | SPREAD | STRICT_SPREAD).
+    topology: informational TPU topology string (e.g. "4x4").
+    """
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    placement_strategy: str = "PACK"
+    topology: str = ""
+
+    def bundle(self) -> Dict[str, float]:
+        res = {"CPU": 1.0}
+        res.update(self.resources_per_worker)
+        if self.use_tpu:
+            res["TPU"] = float(self.chips_per_worker or 1)
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """max_failures: worker-group restarts allowed (-1 = unlimited)."""
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # or "min"
+
+
+@dataclass
+class RunConfig:
+    name: str = ""
+    storage_path: str = ""
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+
+
+@dataclass
+class Result:
+    """What fit() returns (reference: python/ray/air/result.py)."""
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    metrics_history: list = field(default_factory=list)
+    checkpoint: Optional[Any] = None
+    error: Optional[BaseException] = None
+    path: str = ""

@@ -1,0 +1,174 @@
+"""TrainController — gang-schedules and supervises the worker group.
+
+Analogue of the reference's Train v2 controller (reference:
+python/ray/train/v2/_internal/execution/controller/controller.py:96
+_run_control_loop_iteration/:259 _poll_workers, worker_group/worker_group.py,
+failure_policy/). Differences by design: runs in the driver process (fit()
+blocks anyway; a detached controller actor is the reference's resume story,
+ours is the checkpoint manager), and the JAX coordinator address is chosen
+up front because JAX env must be frozen at worker-process spawn.
+
+Control loop: reserve a placement group (one bundle per worker, TPU chips
+first-class) → create one TrainWorker actor per bundle with the JAX env in
+its runtime_env → start() everyone → poll; on any worker failure tear the
+group down and restart it (FailureConfig.max_failures), seeding the new
+group with the latest reported checkpoint.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu import api as _api
+from ray_tpu.train.api_config import (FailureConfig, Result, RunConfig,
+                                      ScalingConfig)
+from ray_tpu.train.worker import TrainWorker
+from ray_tpu.utils import get_logger
+
+logger = get_logger("train.controller")
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TrainController:
+    def __init__(self, train_loop, train_loop_config: Optional[dict],
+                 scaling_config: ScalingConfig, run_config: RunConfig,
+                 worker_env: Optional[Dict[str, Optional[str]]] = None):
+        self._fn_blob = cloudpickle.dumps(train_loop)
+        self._config = train_loop_config
+        self._scaling = scaling_config
+        self._run_cfg = run_config
+        self._worker_env = dict(worker_env or {})
+        self._latest_checkpoint: Any = None
+        self._metrics_history: List[Dict[str, Any]] = []
+
+    # -- worker group lifecycle -----------------------------------------
+    def _make_group(self):
+        n = self._scaling.num_workers
+        bundles = [self._scaling.bundle() for _ in range(n)]
+        pg = ray_tpu.placement_group(
+            bundles, strategy=self._scaling.placement_strategy)
+        if not pg.ready(timeout=120):
+            ray_tpu.remove_placement_group(pg)
+            raise TrainingFailedError(
+                f"could not reserve {n}x{bundles[0]} "
+                f"({self._scaling.placement_strategy})")
+        # Coordinator runs inside rank 0's process — find its host.
+        cw = _api._cw()
+        info = cw._run(cw.controller.call("get_pg_info",
+                                          pg.id.binary())).result()
+        nodes = {n_["node_id"]: n_ for n_ in ray_tpu.nodes()}
+        host0 = nodes[info["bundle_nodes"][0]]["addr"][0]
+        coord = f"{host0}:{_free_port()}"
+
+        actor_cls = ray_tpu.remote(TrainWorker)
+        workers = []
+        for rank in range(n):
+            env: Dict[str, Optional[str]] = dict(self._worker_env)
+            env["RAY_TPU_TRAIN_COORD"] = coord
+            env["RAY_TPU_TRAIN_RANK"] = str(rank)
+            env["RAY_TPU_TRAIN_WORLD"] = str(n)
+            opts = dict(
+                placement_group=pg,
+                placement_group_bundle_index=rank,
+                runtime_env={"env_vars": env},
+                max_restarts=0,  # restarts are group-level, not per-worker
+            )
+            if self._scaling.use_tpu:
+                opts["num_tpus"] = float(self._scaling.chips_per_worker or 1)
+            workers.append(actor_cls.options(**opts).remote())
+        return pg, workers
+
+    def _teardown(self, pg, workers) -> None:
+        for w in workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        try:
+            ray_tpu.remove_placement_group(pg)
+        except Exception:
+            pass
+
+    # -- control loop ----------------------------------------------------
+    def run(self) -> Result:
+        max_failures = self._run_cfg.failure_config.max_failures
+        attempt = 0
+        last_error: Optional[BaseException] = None
+        while max_failures == -1 or attempt <= max_failures:
+            if attempt > 0:
+                logger.info("restarting worker group (attempt %d/%s)",
+                            attempt, max_failures)
+            try:
+                result = self._run_attempt()
+                result.metrics_history = self._metrics_history
+                result.checkpoint = self._latest_checkpoint
+                return result
+            except TrainingFailedError as e:
+                last_error = e
+                attempt += 1
+        return Result(metrics=(self._metrics_history[-1]
+                               if self._metrics_history else {}),
+                      metrics_history=self._metrics_history,
+                      checkpoint=self._latest_checkpoint, error=last_error)
+
+    def _run_attempt(self) -> Result:
+        pg, workers = self._make_group()
+        try:
+            starts = [
+                w.start.remote(
+                    self._fn_blob, self._config,
+                    self._run_cfg.name, self._run_cfg.storage_path,
+                    self._latest_checkpoint)
+                for w in workers]
+            ray_tpu.get(starts, timeout=120)
+            return self._poll_until_done(workers)
+        except TrainingFailedError:
+            raise
+        except Exception as e:
+            raise TrainingFailedError(f"worker group failed: {e!r}") from e
+        finally:
+            self._teardown(pg, workers)
+
+    def _poll_until_done(self, workers) -> Result:
+        poll_period = 0.2
+        while True:
+            try:
+                polls = ray_tpu.get([w.poll.remote() for w in workers],
+                                    timeout=60)
+            except Exception as e:  # worker/actor death mid-training
+                raise TrainingFailedError(
+                    f"worker poll failed: {e!r}") from e
+            for rank, p in enumerate(polls):
+                for metrics, ckpt in p["reported"]:
+                    if rank == 0:
+                        self._metrics_history.append(metrics)
+                    if ckpt is not None:
+                        self._latest_checkpoint = ckpt
+            errs = [(i, p["error"]) for i, p in enumerate(polls)
+                    if p["status"] == "error"]
+            if errs:
+                rank, tb = errs[0]
+                raise TrainingFailedError(
+                    f"train loop failed on rank {rank}:\n{tb}")
+            if all(p["status"] == "finished" for p in polls):
+                final = self._metrics_history[-1] \
+                    if self._metrics_history else {}
+                return Result(metrics=final)
+            time.sleep(poll_period)
+            poll_period = min(poll_period * 1.5, 2.0)

@@ -1,0 +1,51 @@
+"""JaxTrainer — the public data-parallel trainer for JAX/TPU.
+
+Analogue of the reference's trainers (reference:
+python/ray/train/v2/api/data_parallel_trainer.py:60 DataParallelTrainer /
+fit():118 and v2/jax/jax_trainer.py:19 JaxTrainer), TPU-first: the worker
+group is one JAX process per worker, ``jax.distributed`` is initialized
+from env the controller injects at spawn, and inside the loop the user
+composes this framework's SPMD stack (ray_tpu.parallel / ray_tpu.train.spmd)
+over the global device mesh.
+
+Example::
+
+    def loop(config):
+        ctx = ray_tpu.train.get_context()
+        ... jax code over jax.devices() (global across workers) ...
+        ray_tpu.train.report({"loss": loss})
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=4, use_tpu=True,
+                                           chips_per_worker=4))
+    result = trainer.fit()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.api_config import Result, RunConfig, ScalingConfig
+from ray_tpu.train.controller import TrainController
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker, *,
+                 train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 worker_env: Optional[Dict[str, Optional[str]]] = None):
+        """worker_env: extra env vars for every worker process (value None
+        unsets a var). JAX reads its env at interpreter start, so platform
+        selection (JAX_PLATFORMS, XLA_FLAGS, TPU_VISIBLE_CHIPS overrides)
+        must ride here rather than inside the train loop."""
+        self._controller = TrainController(
+            train_loop_per_worker, train_loop_config,
+            scaling_config or ScalingConfig(),
+            run_config or RunConfig(), worker_env)
+
+    def fit(self) -> Result:
+        result = self._controller.run()
+        if result.error is not None:
+            raise result.error
+        return result

@@ -1,0 +1,85 @@
+"""Worker-side train session: report() + get_context().
+
+Analogue of the reference's train session (reference: python/ray/train/
+_internal/session.py get_session / ray.train.report, v2 via
+train/v2/_internal/execution/worker_group/thread_runner.py): the user's
+train loop runs in a thread inside the worker actor and communicates with
+the controller through this module.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class TrainContext:
+    def __init__(self, rank: int, world_size: int,
+                 experiment_name: str = "", storage_path: str = "",
+                 restored_checkpoint: Optional[Any] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.experiment_name = experiment_name
+        self.storage_path = storage_path
+        self._restored_checkpoint = restored_checkpoint
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_checkpoint(self) -> Optional[Any]:
+        """Checkpoint to resume from (set on group restart), else None."""
+        return self._restored_checkpoint
+
+
+class _Session:
+    def __init__(self, ctx: TrainContext):
+        self.ctx = ctx
+        self.lock = threading.Lock()
+        # (metrics, checkpoint) tuples not yet drained by the controller.
+        self.reported: List[Tuple[Dict[str, Any], Optional[Any]]] = []
+        self.finished = False
+        self.error: Optional[str] = None
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Any] = None) -> None:
+        with self.lock:
+            self.reported.append((dict(metrics), checkpoint))
+
+    def drain(self) -> List[Tuple[Dict[str, Any], Optional[Any]]]:
+        with self.lock:
+            out = self.reported
+            self.reported = []
+            return out
+
+
+_session: Optional[_Session] = None
+
+
+def _start_session(ctx: TrainContext) -> _Session:
+    global _session
+    _session = _Session(ctx)
+    return _session
+
+
+def _end_session() -> None:
+    global _session
+    _session = None
+
+
+def get_context() -> TrainContext:
+    if _session is None:
+        raise RuntimeError("not inside a train worker session")
+    return _session.ctx
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Any] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from the train loop.
+
+    Reference analogue: ray.train.report (train/_internal/session.py).
+    """
+    if _session is None:
+        raise RuntimeError("report() called outside a train worker session")
+    _session.report(metrics, checkpoint)

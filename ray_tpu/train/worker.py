@@ -1,0 +1,101 @@
+"""TrainWorker — the per-process training actor.
+
+Analogue of the reference's Train v2 worker (reference:
+python/ray/train/v2/_internal/execution/worker_group/worker.py +
+thread_runner.py — run the user loop in a thread, poll status), with the
+JAX backend bolted in: ``start()`` initializes ``jax.distributed`` from the
+env the controller set at actor spawn (reference:
+python/ray/train/v2/jax/config.py _JaxBackend.on_start).
+
+JAX env (JAX_PLATFORMS, XLA_FLAGS, TPU_VISIBLE_CHIPS, coordinator vars) is
+frozen at interpreter start, which is why the controller passes it through
+``runtime_env={"env_vars": ...}`` rather than setting it here.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu.train import session as _session_mod
+
+
+class TrainWorker:
+    """Gang-scheduled by the TrainController; one JAX process per actor."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._session: Optional[_session_mod._Session] = None
+        self._jax_initialized = False
+
+    # -- backend ---------------------------------------------------------
+    def _init_jax_distributed(self) -> Dict[str, Any]:
+        coord = os.environ.get("RAY_TPU_TRAIN_COORD", "")
+        world = int(os.environ.get("RAY_TPU_TRAIN_WORLD", "1"))
+        rank = int(os.environ.get("RAY_TPU_TRAIN_RANK", "0"))
+        import jax
+        if world > 1 and coord and not self._jax_initialized:
+            # Blocks until all `world` processes join the coordinator
+            # (worker 0 hosts it — reference: v2/jax/config.py on_start).
+            jax.distributed.initialize(coord, num_processes=world,
+                                       process_id=rank)
+            self._jax_initialized = True
+        return {"rank": rank, "world": world,
+                "local_devices": jax.local_device_count(),
+                "global_devices": jax.device_count()}
+
+    # -- controller API --------------------------------------------------
+    def start(self, fn_blob: bytes, config: Optional[dict],
+              experiment_name: str = "", storage_path: str = "",
+              restored_checkpoint: Any = None) -> None:
+        """Launch the user train loop in a thread and return immediately
+        (the actor stays responsive to poll())."""
+        assert self._thread is None, "start() called twice"
+        rank = int(os.environ.get("RAY_TPU_TRAIN_RANK", "0"))
+        world = int(os.environ.get("RAY_TPU_TRAIN_WORLD", "1"))
+        ctx = _session_mod.TrainContext(rank, world, experiment_name,
+                                        storage_path, restored_checkpoint)
+        self._session = _session_mod._start_session(ctx)
+        fn = cloudpickle.loads(fn_blob)
+
+        def _run():
+            try:
+                self._init_jax_distributed()
+                if config is None:
+                    fn()
+                else:
+                    fn(config)
+            except BaseException:
+                self._session.error = traceback.format_exc()
+            finally:
+                self._session.finished = True
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="train-loop")
+        self._thread.start()
+
+    def poll(self) -> dict:
+        """Drain new report()s + liveness/status (reference:
+        controller.py _poll_workers)."""
+        s = self._session
+        if s is None:
+            return {"status": "idle", "reported": []}
+        reported = s.drain()
+        if s.error is not None:
+            return {"status": "error", "error": s.error, "reported": reported}
+        if s.finished:
+            return {"status": "finished", "reported": reported}
+        return {"status": "running", "reported": reported}
+
+    def jax_info(self) -> dict:
+        import jax
+        return {"backend": jax.default_backend(),
+                "local_devices": jax.local_device_count(),
+                "global_devices": jax.device_count()}
+
+    def shutdown_worker(self) -> str:
+        return "ok"

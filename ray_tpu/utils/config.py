@@ -84,7 +84,7 @@ _flag("testing_rpc_failure", str, "", "Comma list 'method=prob' to randomly fail
 _flag("testing_event_loop_delay_us", int, 0, "Inject delay into event-loop handlers (asio-delay analogue).")
 
 # --- TPU / accelerator plane ---
-_flag("tpu_chips_per_host", int, 4, "Fallback chip count when discovery unavailable.")
+_flag("tpu_chips_per_host", int, 0, "Explicit chip count (0 = auto-detect).")
 _flag("tpu_visible_chips", str, "", "Analogue of TPU_VISIBLE_CHIPS pinning.")
 _flag("collective_cpu_fallback", bool, True, "Allow CPU fallback collectives when no TPU present.")
 

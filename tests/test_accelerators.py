@@ -1,0 +1,79 @@
+"""TPU accelerator manager tests: chips as a scheduler resource, chip
+pinning via TPU_VISIBLE_CHIPS, release on actor death (reference analogue:
+python/ray/tests/accelerators/test_tpu.py + tpu.py:199 manager)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import accelerators
+from ray_tpu.core.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def tpu_cluster():
+    c = Cluster(num_nodes=1, resources={"CPU": 4, "TPU": 4})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_chips_from_bounds():
+    assert accelerators._chips_from_bounds("2,2,1") == 4
+    assert accelerators._chips_from_bounds("2,2,2") == 8
+    assert accelerators._chips_from_bounds("junk") is None
+
+
+def test_worker_env_for_chips():
+    env = accelerators.worker_env_for_chips([1, 3])
+    assert env["TPU_VISIBLE_CHIPS"] == "1,3"
+
+
+def test_tpu_resource_advertised(tpu_cluster):
+    assert ray_tpu.cluster_resources().get("TPU") == 4.0
+
+
+def test_actor_gets_visible_chips(tpu_cluster):
+    @ray_tpu.remote
+    class ChipUser:
+        def chips(self):
+            return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    a = ChipUser.options(num_tpus=2).remote()
+    chips_a = ray_tpu.get(a.chips.remote())
+    b = ChipUser.options(num_tpus=2).remote()
+    chips_b = ray_tpu.get(b.chips.remote())
+    # Disjoint chip sets, 2 each, out of 0..3.
+    sa, sb = set(chips_a.split(",")), set(chips_b.split(","))
+    assert len(sa) == len(sb) == 2
+    assert not (sa & sb)
+    assert (sa | sb) <= {"0", "1", "2", "3"}
+    # No chips left: a third 2-chip actor must not be schedulable now.
+    assert ray_tpu.available_resources().get("TPU", 0) == 0
+    # Kill one: chips + resource come back.
+    ray_tpu.kill(a)
+    import time
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("TPU", 0) == 2:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources().get("TPU", 0) == 2
+    c = ChipUser.options(num_tpus=2).remote()
+    chips_c = ray_tpu.get(c.chips.remote())
+    assert set(chips_c.split(",")) == sa  # freed chips reused
+    ray_tpu.kill(b)
+    ray_tpu.kill(c)
+
+
+def test_env_vars_runtime_env(tpu_cluster):
+    @ray_tpu.remote
+    class EnvActor:
+        def get(self, k):
+            return os.environ.get(k)
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"MY_FLAG": "42", "PATH2": None}}).remote()
+    assert ray_tpu.get(a.get.remote("MY_FLAG")) == "42"
+    ray_tpu.kill(a)
