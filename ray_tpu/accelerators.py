@@ -70,14 +70,14 @@ def visible_chip_ids() -> List[int]:
     spec = GlobalConfig.tpu_visible_chips.strip()
     if spec:
         ids = sorted({int(x) for x in spec.split(",") if x.strip()})
-        return [i for i in ids if 0 <= i < max(n, max(ids) + 1)]
+        return [i for i in ids if 0 <= i < n]
     return list(range(n))
 
 
 def accelerator_type() -> str:
     """e.g. 'v5e-16' — from TPU VM env, else empty."""
     t = os.environ.get("TPU_ACCELERATOR_TYPE", "")
-    return t if re.match(r"^v\d", t) else t
+    return t if re.match(r"^v\d", t) else ""
 
 
 def slice_name() -> str:

@@ -170,17 +170,12 @@ class NodeAgent:
             lease = self.leases.pop(w.current_lease, None)
             if lease:
                 _, res, pg, bundle_index = lease
-                if pg is not None:
-                    ba = self.bundle_available.get((pg, bundle_index))
-                    if ba is not None:
-                        resources_add(ba, res)
-                elif res:
-                    await self._free_resources(res)
+                await self._return_resources(res, pg, bundle_index)
             w.current_lease = None
         if w.dedicated_actor is not None:
             actor_id = w.dedicated_actor
             w.dedicated_actor = None
-            self._release_actor_allocation(actor_id)
+            await self._release_actor_allocation(actor_id)
             try:
                 await self.controller.call(
                     "report_actor_death", actor_id,
@@ -188,7 +183,20 @@ class NodeAgent:
             except Exception:
                 pass
 
-    def _release_actor_allocation(self, actor_id: bytes) -> None:
+    async def _return_resources(self, res: Dict[str, float],
+                                pg: Optional[bytes],
+                                bundle_index: int) -> None:
+        """Give resources back to their pool (bundle or node) + wake waiters."""
+        if not res:
+            return
+        if pg is not None:
+            ba = self.bundle_available.get((pg, bundle_index))
+            if ba is not None:
+                resources_add(ba, res)
+        else:
+            await self._free_resources(res)
+
+    async def _release_actor_allocation(self, actor_id: bytes) -> None:
         chips = self.tpu_assigned.pop(actor_id, None)
         if chips:
             self.tpu_free_chips.extend(chips)
@@ -196,12 +204,7 @@ class NodeAgent:
         alloc = self.actor_allocations.pop(actor_id, None)
         if alloc:
             res, pg, bundle_index = alloc
-            if pg is not None:
-                ba = self.bundle_available.get((pg, bundle_index))
-                if ba is not None:
-                    resources_add(ba, res)
-            elif res:
-                resources_add(self.resources_available, res)
+            await self._return_resources(res, pg, bundle_index)
 
     async def _free_resources(self, res: Dict[str, float]) -> None:
         async with self._resource_cv:
@@ -367,6 +370,12 @@ class NodeAgent:
                           resources: dict, pg: Optional[bytes],
                           bundle_index: int,
                           env_vars: Optional[Dict[str, str]] = None) -> dict:
+        tpu_req = float(resources.get("TPU", 0))
+        if tpu_req != int(tpu_req):
+            # Chips are whole devices: fractional TPU would desynchronize
+            # chip pinning from the resource vector.
+            raise ValueError(f"TPU requests must be whole chips, got "
+                             f"{tpu_req}")
         avail = (self.bundle_available.get((pg, bundle_index))
                  if pg is not None else self.resources_available)
         if avail is None or not resources_fit(avail, resources):
@@ -374,7 +383,7 @@ class NodeAgent:
         resources_sub(avail, resources)
         # Pin specific TPU chips to this worker (TPU_VISIBLE_CHIPS).
         chips: List[int] = []
-        n_tpu = int(resources.get("TPU", 0))
+        n_tpu = int(tpu_req)
         if n_tpu > 0:
             if len(self.tpu_free_chips) < n_tpu:
                 resources_add(avail, resources)
@@ -386,6 +395,7 @@ class NodeAgent:
             # Explicit user pinning wins over automatic assignment.
             for k, v in accelerators.worker_env_for_chips(chips).items():
                 env_vars.setdefault(k, v)
+        w: Optional[WorkerProc] = None
         try:
             w = self._spawn_worker(env_vars)  # dedicated worker, never pooled
             await asyncio.wait_for(w.ready.wait(),
@@ -399,18 +409,27 @@ class NodeAgent:
             await w.client.call("create_actor_local", spec_blob)
             return {"addr": w.addr}
         except Exception:
+            # Full cleanup so the orphaned worker's later death cannot
+            # double-release resources or report a bogus actor death.
+            self.actor_allocations.pop(actor_id, None)
+            self.tpu_assigned.pop(actor_id, None)
+            if w is not None:
+                w.dedicated_actor = None
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
             resources_add(avail, resources)
             if chips:
                 self.tpu_free_chips.extend(chips)
                 self.tpu_free_chips.sort()
-                self.tpu_assigned.pop(actor_id, None)
             raise
 
     async def kill_actor_worker(self, actor_id: bytes) -> None:
         for w in self.workers.values():
             if w.dedicated_actor == actor_id:
                 w.dedicated_actor = None  # suppress death report (intended)
-                self._release_actor_allocation(actor_id)
+                await self._release_actor_allocation(actor_id)
                 w.proc.terminate()
                 return
 
@@ -564,6 +583,17 @@ class NodeAgent:
 
     async def ping(self) -> str:
         return "pong"
+
+    async def probe_free_port(self) -> int:
+        """Pick a currently-free TCP port on THIS host (used by the train
+        controller to place the jax.distributed coordinator on rank 0's
+        node rather than probing from the driver's host)."""
+        import socket
+        s = socket.socket()
+        s.bind((self.host, 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
 
     async def shutdown_node(self) -> None:
         self._shutdown = True

@@ -17,7 +17,6 @@ group with the latest reported checkpoint.
 
 from __future__ import annotations
 
-import socket
 import time
 from typing import Any, Dict, List, Optional
 
@@ -37,14 +36,6 @@ class TrainingFailedError(RuntimeError):
     pass
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 class TrainController:
     def __init__(self, train_loop, train_loop_config: Optional[dict],
                  scaling_config: ScalingConfig, run_config: RunConfig,
@@ -58,23 +49,23 @@ class TrainController:
         self._metrics_history: List[Dict[str, Any]] = []
 
     # -- worker group lifecycle -----------------------------------------
-    def _make_group(self):
+    def _make_group(self, pg):
         n = self._scaling.num_workers
-        bundles = [self._scaling.bundle() for _ in range(n)]
-        pg = ray_tpu.placement_group(
-            bundles, strategy=self._scaling.placement_strategy)
         if not pg.ready(timeout=120):
-            ray_tpu.remove_placement_group(pg)
             raise TrainingFailedError(
-                f"could not reserve {n}x{bundles[0]} "
+                f"could not reserve {n}x{self._scaling.bundle()} "
                 f"({self._scaling.placement_strategy})")
-        # Coordinator runs inside rank 0's process — find its host.
+        # Coordinator runs inside rank 0's process — pick a free port ON
+        # rank 0's node via its agent (a driver-side probe would test the
+        # wrong host on multi-host clusters).
         cw = _api._cw()
         info = cw._run(cw.controller.call("get_pg_info",
                                           pg.id.binary())).result()
         nodes = {n_["node_id"]: n_ for n_ in ray_tpu.nodes()}
-        host0 = nodes[info["bundle_nodes"][0]]["addr"][0]
-        coord = f"{host0}:{_free_port()}"
+        addr0 = tuple(nodes[info["bundle_nodes"][0]]["addr"])
+        port = cw._run(cw._client_for_worker(addr0).call(
+            "probe_free_port")).result()
+        coord = f"{addr0[0]}:{port}"
 
         actor_cls = ray_tpu.remote(TrainWorker)
         workers = []
@@ -92,7 +83,7 @@ class TrainController:
             if self._scaling.use_tpu:
                 opts["num_tpus"] = float(self._scaling.chips_per_worker or 1)
             workers.append(actor_cls.options(**opts).remote())
-        return pg, workers
+        return workers
 
     def _teardown(self, pg, workers) -> None:
         for w in workers:
@@ -128,8 +119,13 @@ class TrainController:
                       checkpoint=self._latest_checkpoint, error=last_error)
 
     def _run_attempt(self) -> Result:
-        pg, workers = self._make_group()
+        n = self._scaling.num_workers
+        pg = ray_tpu.placement_group(
+            [self._scaling.bundle() for _ in range(n)],
+            strategy=self._scaling.placement_strategy)
+        workers: list = []
         try:
+            workers = self._make_group(pg)
             starts = [
                 w.start.remote(
                     self._fn_blob, self._config,
