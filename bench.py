@@ -29,7 +29,8 @@ def main() -> None:
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, d_model=2048, n_layers=8,
-                          n_heads=16, n_kv_heads=16, d_ff=5504, max_seq=2048)
+                          n_heads=16, n_kv_heads=16, d_ff=5504, max_seq=2048,
+                          remat_policy="dots_nobatch")
         batch, seq, steps = 8, 2048, 10
     else:  # CPU smoke fallback so the harness never hard-fails
         cfg = LlamaConfig.tiny(max_seq=128)

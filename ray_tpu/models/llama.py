@@ -51,6 +51,13 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16          # activation/compute dtype
     param_dtype: Any = jnp.float32     # master parameter dtype
     remat: bool = True
+    # Rematerialization policy when remat=True: "none" (save everything the
+    # scan carries anyway), "full" (recompute everything — min memory, max
+    # recompute), "dots" (save every matmul output), "dots_nobatch" (save
+    # weight-matmul outputs, recompute attention/elementwise — usually the
+    # MFU sweet spot on TPU: HBM traffic for the big dots is avoided while
+    # the recompute is cheap non-MXU work).
+    remat_policy: str = "full"
     num_microbatches: int = 0          # 0 => equal to pp size
 
     @property
@@ -216,7 +223,15 @@ def _stack_fwd(layers_p: Dict[str, Any], x: jax.Array, cos, sin,
         return (x, aux_sum + aux), None
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        policies = {
+            "full": None,
+            "dots": jax.checkpoint_policies.dots_saveable,
+            "dots_nobatch":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        }
+        policy = policies.get(cfg.remat_policy)
+        body = jax.checkpoint(body, policy=policy) if policy is not None \
+            else jax.checkpoint(body)
     aux0 = (x[(0,) * x.ndim] * 0).astype(jnp.float32)  # inherits x's vma type
     (x, aux), _ = jax.lax.scan(body, (x, aux0), layers_p)
     return x, aux

@@ -11,9 +11,9 @@ Design:
   * ``_flash_fwd_pallas`` — Pallas TPU forward kernel, online-softmax over KV
     blocks with VMEM accumulators (MXU-aligned 128-multiple block shapes).
   * ``flash_attention`` — custom_vjp: Pallas forward on TPU (reference forward
-    elsewhere); backward is a blockwise lax.scan at the XLA level using the
-    saved LSE, so the full [Sq, Skv] matrix is never materialized and every
-    inner op is an MXU matmul.
+    elsewhere); backward is the standard two-kernel Pallas flash backward
+    (dK/dV pass + dQ pass, bf16 MXU matmuls with f32 accumulation), with a
+    blockwise XLA fallback off-TPU / for unaligned shapes.
 
 Layout: [batch, num_heads, seq, head_dim] (BHSD).
 """
@@ -84,13 +84,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    def _body():
+    def _body(masked: bool):
         q = q_ref[0]  # [block_q, d]
         k = k_ref[0]  # [block_k, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        if masked:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
@@ -110,10 +110,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[:] = l_new
 
     if causal:
-        # Skip fully-masked KV blocks (block above the diagonal).
-        pl.when(kv_idx * block_k <= q_idx * block_q + (block_q - 1))(_body)
+        # Three block classes: fully masked (skip entirely), fully visible
+        # (no mask arithmetic — the bulk below the diagonal), diagonal
+        # (per-element mask).
+        visible = kv_idx * block_k <= q_idx * block_q + (block_q - 1)
+        full = kv_idx * block_k + (block_k - 1) <= q_idx * block_q
+        pl.when(visible & jnp.logical_not(full))(
+            functools.partial(_body, True))
+        pl.when(full)(functools.partial(_body, False))
     else:
-        _body()
+        _body(False)
 
     @pl.when(kv_idx == (kv_seq_len // block_k) - 1)
     def _finalize():
@@ -122,7 +128,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = (m_ref[:] + jnp.log(l))[:, 0]
 
 
-def _flash_fwd_pallas(q, k, v, *, causal, sm_scale, block_q=256, block_k=256):
+def _flash_fwd_pallas(q, k, v, *, causal, sm_scale, block_q=1024,
+                      block_k=1024):
     b, h, sq, d = q.shape
     skv = k.shape[2]
     block_q = min(block_q, sq)
@@ -180,6 +187,192 @@ def _fwd_with_lse_reference(q, k, v, *, causal, sm_scale):
 
 
 # ---------------------------------------------------------------------------
+# Pallas TPU backward kernels (standard two-kernel flash backward:
+# one pass producing dK/dV with q innermost, one producing dQ with kv
+# innermost; all MXU matmuls in bf16 with f32 accumulation)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          sm_scale: float, causal: bool, block_q: int,
+                          block_k: int, q_seq_len: int):
+    kv_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _body(masked: bool):
+        q = q_ref[0]          # [bq, d]
+        k = k_ref[0]          # [bk, d]
+        v = v_ref[0]          # [bk, d]
+        do = do_ref[0]        # [bq, d]
+        lse = lse_ref[0, 0][:, None]     # [bq, 1]
+        delta = delta_ref[0, 0][:, None]  # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if masked:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse)  # [bq, bk] f32
+        pb = p.astype(v.dtype)
+        # dv += p^T @ do   (contract over bq)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dp = do @ v^T    [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        # dk += ds^T @ q   (contract over bq)
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        visible = q_idx * block_q + (block_q - 1) >= kv_idx * block_k
+        full = q_idx * block_q >= kv_idx * block_k + (block_k - 1)
+        pl.when(visible & jnp.logical_not(full))(
+            functools.partial(_body, True))
+        pl.when(full)(functools.partial(_body, False))
+    else:
+        _body(False)
+
+    @pl.when(q_idx == (q_seq_len // block_q) - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, sm_scale: float, causal: bool,
+                         block_q: int, block_k: int, kv_seq_len: int):
+    q_idx = pl.program_id(1)
+    kv_idx = pl.program_id(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _body(masked: bool):
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if masked:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        # dq += ds @ k
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        visible = kv_idx * block_k <= q_idx * block_q + (block_q - 1)
+        full = kv_idx * block_k + (block_k - 1) <= q_idx * block_q
+        pl.when(visible & jnp.logical_not(full))(
+            functools.partial(_body, True))
+        pl.when(full)(functools.partial(_body, False))
+    else:
+        _body(False)
+
+    @pl.when(kv_idx == (kv_seq_len // block_k) - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, dout, *, causal, sm_scale,
+                      block_q=1024, block_k=512):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    assert sq % block_q == 0 and skv % block_k == 0
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, skv, d)
+    vr = v.reshape(b * h, skv, d)
+    dor = dout.astype(q.dtype).reshape(b * h, sq, d)
+    lse_r = lse.reshape(b * h, 1, sq)
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(b * h, 1, sq)
+
+    dkv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          q_seq_len=sq),
+        grid=(b * h, skv // block_k, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, skv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qr, kr, vr, dor, lse_r, delta)
+    dk, dv = dkv
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          kv_seq_len=skv),
+        grid=(b * h, sq // block_q, skv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qr, kr, vr, dor, lse_r, delta)[0]
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, skv, d),
+            dv.reshape(b, h, skv, d))
+
+
+# ---------------------------------------------------------------------------
 # custom_vjp wrapper with blockwise XLA backward
 # ---------------------------------------------------------------------------
 
@@ -207,6 +400,10 @@ def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_k_bwd):
 def _flash_vjp_bwd(causal, sm_scale, block_k_bwd, res, dout):
     q, k, v, out, lse = res
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    if _on_tpu() and q.shape[2] % 128 == 0 and k.shape[2] % 128 == 0 \
+            and q.shape[-1] % 128 == 0:
+        return _flash_bwd_pallas(q, k, v, out, lse, dout, causal=causal,
+                                 sm_scale=scale)
     skv = k.shape[2]
     block = min(block_k_bwd, skv)
     n_blocks = skv // block if skv % block == 0 else 1
