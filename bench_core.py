@@ -1,0 +1,208 @@
+"""Control-plane microbenchmarks vs the reference's published numbers.
+
+Mirrors the reference's microbenchmark suite (reference:
+python/ray/_private/ray_perf.py + release/microbenchmark/run_microbenchmark.py;
+published results in release/perf_metrics/microbenchmark.json, mirrored in
+BASELINE.md). Prints one JSON line per metric:
+  {"metric", "value", "unit", "ref": <reference's number>, "vs_ref": ratio}
+
+Run: python bench_core.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import ray_tpu
+
+QUICK = "--quick" in sys.argv
+SECONDS = 2.0 if QUICK else 5.0
+
+REF = {  # BASELINE.md (release/perf_metrics/microbenchmark.json @ 2.49.1)
+    "1_1_actor_calls_sync": 1826,
+    "1_1_actor_calls_async": 7926,
+    "single_client_tasks_sync": 901,
+    "single_client_tasks_async": 7419,
+    "single_client_put_calls": 4795,
+    "single_client_get_calls": 9177,
+    "single_client_put_gigabytes": 20.35,
+    "placement_group_create_removal": 751,
+    "n_n_actor_calls_async": 24809,
+}
+
+
+def emit(metric: str, value: float, unit: str) -> None:
+    import os
+    ref = REF.get(metric)
+    print(json.dumps({
+        "metric": metric, "value": round(value, 2), "unit": unit,
+        "ref": ref, "vs_ref": round(value / ref, 3) if ref else None,
+        # Reference numbers were produced on 64-core m4.16xlarge machines
+        # (BASELINE.md); concurrency-bound metrics scale with cores.
+        "host_cores": os.cpu_count(),
+    }), flush=True)
+
+
+def timed_loop(fn, seconds: float = SECONDS) -> float:
+    """Run fn repeatedly for ~seconds; return ops/sec."""
+    # warmup
+    for _ in range(5):
+        fn()
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        fn()
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def ping(self):
+        self.n += 1
+        return self.n
+
+
+@ray_tpu.remote
+def _noop():
+    return None
+
+
+def bench_actor_calls_sync():
+    a = Counter.remote()
+    ray_tpu.get(a.ping.remote())
+    rate = timed_loop(lambda: ray_tpu.get(a.ping.remote()))
+    emit("1_1_actor_calls_sync", rate, "calls/s")
+    ray_tpu.kill(a)
+
+
+def bench_actor_calls_async():
+    a = Counter.remote()
+    ray_tpu.get(a.ping.remote())
+    batch = 200 if QUICK else 1000
+
+    def burst():
+        refs = [a.ping.remote() for _ in range(batch)]
+        ray_tpu.get(refs[-1])
+
+    for _ in range(2):
+        burst()
+    t0 = time.perf_counter()
+    reps = 3 if QUICK else 5
+    for _ in range(reps):
+        burst()
+    rate = batch * reps / (time.perf_counter() - t0)
+    emit("1_1_actor_calls_async", rate, "calls/s")
+    ray_tpu.kill(a)
+
+
+def bench_tasks_sync():
+    ray_tpu.get(_noop.remote())
+    rate = timed_loop(lambda: ray_tpu.get(_noop.remote()))
+    emit("single_client_tasks_sync", rate, "tasks/s")
+
+
+def bench_tasks_async():
+    batch = 100 if QUICK else 500
+
+    def burst():
+        ray_tpu.get([_noop.remote() for _ in range(batch)])
+
+    burst()
+    t0 = time.perf_counter()
+    reps = 3 if QUICK else 5
+    for _ in range(reps):
+        burst()
+    rate = batch * reps / (time.perf_counter() - t0)
+    emit("single_client_tasks_async", rate, "tasks/s")
+
+
+def bench_put_calls():
+    small = b"x" * 200_000  # >100KiB: forces the shm store path
+    rate = timed_loop(lambda: ray_tpu.put(small))
+    emit("single_client_put_calls", rate, "puts/s")
+
+
+def bench_get_calls():
+    ref = ray_tpu.put(b"x" * 200_000)
+    rate = timed_loop(lambda: ray_tpu.get(ref))
+    emit("single_client_get_calls", rate, "gets/s")
+
+
+def bench_put_gigabytes():
+    # numpy array: exercises the pickle5 out-of-band zero-copy buffer path
+    # (the reference's put_gigabytes also puts numpy data, ray_perf.py).
+    arr = np.ones((1024 ** 3 if not QUICK else 256 * 1024 ** 2) // 8,
+                  np.float64)
+    nbytes = arr.nbytes
+
+    def put_one():
+        ray_tpu.put(arr)
+
+    put_one()
+    t0 = time.perf_counter()
+    reps = 2 if QUICK else 4
+    for _ in range(reps):
+        put_one()
+    gbps = nbytes * reps / (time.perf_counter() - t0) / 1024 ** 3
+    emit("single_client_put_gigabytes", gbps, "GiB/s")
+
+
+def bench_pg_create_removal():
+    def once():
+        pg = ray_tpu.placement_group([{"CPU": 0.01}])
+        pg.ready(timeout=30)
+        ray_tpu.remove_placement_group(pg)
+
+    rate = timed_loop(once, seconds=min(SECONDS, 3.0))
+    emit("placement_group_create_removal", rate, "ops/s")
+
+
+def bench_n_n_actor_calls():
+    n = 4
+    actors = [Counter.remote() for _ in range(n)]
+    ray_tpu.get([a.ping.remote() for a in actors])
+    batch = 100 if QUICK else 500
+
+    def burst():
+        refs = []
+        for a in actors:
+            refs.extend(a.ping.remote() for _ in range(batch))
+        ray_tpu.get(refs)
+
+    burst()
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        burst()
+    rate = n * batch * reps / (time.perf_counter() - t0)
+    emit("n_n_actor_calls_async", rate, "calls/s")
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def main() -> None:
+    ray_tpu.init(resources={"CPU": 16})
+    try:
+        bench_tasks_sync()
+        bench_tasks_async()
+        bench_actor_calls_sync()
+        bench_actor_calls_async()
+        bench_n_n_actor_calls()
+        bench_put_calls()
+        bench_get_calls()
+        bench_put_gigabytes()
+        bench_pg_create_removal()
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,11 @@ class CoreWorker:
         # pinned for the actor's lifetime (restarts re-resolve them),
         # released when the actor is killed or observed dead.
         self._actor_arg_refs: Dict[bytes, List[ObjectRef]] = {}
+        # Lease-cached dispatch state, per scheduling class.
+        self._class_queues: Dict[tuple, list] = {}
+        self._class_pumps: Dict[tuple, asyncio.Task] = {}
+        self._class_runners: Dict[tuple, set] = {}
+        self._class_events: Dict[tuple, asyncio.Event] = {}
         self._next_put_index = 0
 
         self._run(self._async_init()).result()
@@ -608,26 +613,127 @@ class CoreWorker:
         raise WorkerCrashedError(
             f"task {spec.name} failed after {attempts} attempts: {last_exc!r}")
 
+    # -- lease-cached dispatch (reference: normal_task_submitter.cc lease
+    # caching per scheduling class + backlog pipelining) ------------------
+    def _sched_class(self, spec: TaskSpec) -> tuple:
+        strat = spec.scheduling_strategy
+        strat_key = tuple(sorted(strat.items())) if isinstance(strat, dict) \
+            else strat
+        return (tuple(sorted(spec.resources.items())), spec.placement_group,
+                spec.pg_bundle_index, strat_key)
+
     async def _submit_once(self, spec: TaskSpec) -> None:
-        while True:
-            lease = await self.agent.call(
-                "request_lease", spec.resources, spec.placement_group,
-                spec.pg_bundle_index, spec.scheduling_strategy)
-            if lease.get("granted"):
-                break
-            await asyncio.sleep(0.05)
+        """Enqueue on the scheduling class; a per-class lease pump feeds
+        queued tasks through cached worker leases (one RPC stream per
+        leased worker, tasks pipelined sequentially)."""
+        key = self._sched_class(spec)
+        q = self._class_queues.get(key)
+        if q is None:
+            q = self._class_queues[key] = []
+        fut = asyncio.get_running_loop().create_future()
+        q.append((spec, fut))
+        self._class_event(key).set()
+        self._ensure_pump(key)
+        await fut
+
+    def _class_event(self, key: tuple) -> asyncio.Event:
+        ev = self._class_events.get(key)
+        if ev is None:
+            ev = self._class_events[key] = asyncio.Event()
+        return ev
+
+    def _ensure_pump(self, key: tuple) -> None:
+        if key not in self._class_pumps:
+            self._class_pumps[key] = asyncio.ensure_future(self._pump(key))
+
+    async def _pump(self, key: tuple) -> None:
+        """Acquire leases while the class has backlog; one denied-lease
+        poller per CLASS (not per task)."""
+        try:
+            q = self._class_queues[key]
+            runners = self._class_runners.setdefault(key, set())
+            ev = self._class_event(key)
+            max_leases = GlobalConfig.max_pending_lease_requests_per_class
+            fail_streak = 0
+            while q:
+                want = max(1, min(max_leases, len(q))) - len(runners)
+                if want <= 0:
+                    # Enough leased workers for the backlog; sleep until a
+                    # runner finishes or a new task arrives (no polling).
+                    ev.clear()
+                    try:
+                        await asyncio.wait_for(ev.wait(), 0.5)
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
+                spec0 = q[0][0]
+                results = await asyncio.gather(
+                    *[self.agent.call(
+                        "request_lease", spec0.resources,
+                        spec0.placement_group, spec0.pg_bundle_index,
+                        spec0.scheduling_strategy) for _ in range(want)],
+                    return_exceptions=True)
+                granted = [r for r in results
+                           if isinstance(r, dict) and r.get("granted")]
+                errors = [r for r in results if isinstance(r, BaseException)]
+                for lease in granted:
+                    runner = asyncio.ensure_future(
+                        self._lease_runner(key, lease))
+                    runners.add(runner)
+                    runner.add_done_callback(
+                        lambda t, _r=runners, _e=ev: (_r.discard(t),
+                                                      _e.set()))
+                if errors and len(errors) == len(results):
+                    # Agent unreachable: don't hang callers forever — after
+                    # a sustained streak, fail everything still queued so
+                    # _submit_with_retries / the caller sees the error.
+                    fail_streak += 1
+                    if fail_streak >= 40:
+                        while q:
+                            _, fut = q.pop(0)
+                            if not fut.done():
+                                fut.set_exception(WorkerCrashedError(
+                                    f"node agent unreachable: {errors[0]!r}"))
+                        return
+                else:
+                    fail_streak = 0
+                if not granted:
+                    await asyncio.sleep(0.05)
+        finally:
+            self._class_pumps.pop(key, None)
+            # Re-arm if tasks raced in while we were exiting.
+            if self._class_queues.get(key):
+                self._ensure_pump(key)
+
+    async def _lease_runner(self, key: tuple, lease: dict) -> None:
+        """Feed queued tasks of this class through one leased worker
+        sequentially; return the lease when the backlog drains."""
+        q = self._class_queues[key]
         worker_addr = tuple(lease["worker_addr"])
         lease_node = lease.get("spilled_to", self.agent_addr)
+        client = self._client_for_worker(worker_addr)
         try:
-            reply = await self._client_for_worker(worker_addr).call(
-                "push_task", cloudpickle.dumps(spec))
-            self._process_task_reply(spec, reply)
+            while q:
+                spec, fut = q.pop(0)
+                if fut.done():  # cancelled/raced
+                    continue
+                try:
+                    reply = await client.call("push_task",
+                                              cloudpickle.dumps(spec))
+                    self._process_task_reply(spec, reply)
+                    self._release_arg_refs(spec)
+                    fut.set_result(None)
+                except BaseException as e:
+                    if not fut.done():
+                        fut.set_exception(
+                            e if isinstance(e, Exception)
+                            else WorkerCrashedError(repr(e)))
+                    return  # lease's worker is suspect: drop the lease
         finally:
-            agent = self.agent if lease_node == self.agent_addr else \
-                self._client_for_worker(tuple(lease_node))
+            agent = self.agent if tuple(lease_node) == tuple(self.agent_addr) \
+                else self._client_for_worker(tuple(lease_node))
             asyncio.ensure_future(self._return_lease_quiet(
                 agent, lease["lease_id"]))
-        self._release_arg_refs(spec)
 
     async def _return_lease_quiet(self, agent: RpcClient, lease_id) -> None:
         try:

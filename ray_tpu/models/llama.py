@@ -225,11 +225,15 @@ def _stack_fwd(layers_p: Dict[str, Any], x: jax.Array, cos, sin,
     if cfg.remat:
         policies = {
             "full": None,
+            "none": jax.checkpoint_policies.everything_saveable,
             "dots": jax.checkpoint_policies.dots_saveable,
             "dots_nobatch":
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         }
-        policy = policies.get(cfg.remat_policy)
+        if cfg.remat_policy not in policies:
+            raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
+                             f"one of {sorted(policies)}")
+        policy = policies[cfg.remat_policy]
         body = jax.checkpoint(body, policy=policy) if policy is not None \
             else jax.checkpoint(body)
     aux0 = (x[(0,) * x.ndim] * 0).astype(jnp.float32)  # inherits x's vma type

@@ -42,6 +42,15 @@ def _on_tpu() -> bool:
         return False
 
 
+def _pick_block(seq: int, pref: int) -> int:
+    """Largest 128-multiple block <= pref that divides seq (seq % 128 == 0
+    is guaranteed by the dispatch gate, so 128 always works)."""
+    b = min(pref, seq)
+    while b > 128 and seq % b != 0:
+        b //= 2
+    return b if seq % b == 0 else 128
+
+
 # ---------------------------------------------------------------------------
 # Reference implementation
 # ---------------------------------------------------------------------------
@@ -132,9 +141,8 @@ def _flash_fwd_pallas(q, k, v, *, causal, sm_scale, block_q=1024,
                       block_k=1024):
     b, h, sq, d = q.shape
     skv = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, skv)
-    assert sq % block_q == 0 and skv % block_k == 0, (sq, skv, block_q, block_k)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(skv, block_k)
     grid = (b * h, sq // block_q, skv // block_k)
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, skv, d)
@@ -305,9 +313,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, *, causal, sm_scale,
                       block_q=1024, block_k=512):
     b, h, sq, d = q.shape
     skv = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, skv)
-    assert sq % block_q == 0 and skv % block_k == 0
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(skv, block_k)
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, skv, d)
     vr = v.reshape(b * h, skv, d)

@@ -70,7 +70,7 @@ _flag("object_spill_dir", str, "", "Directory for spilled objects (default: sess
 
 # --- scheduling ---
 _flag("scheduler_spread_threshold", float, 0.5, "Hybrid policy: pack below this utilization, then spread.")
-_flag("max_pending_lease_requests_per_class", int, 10, "Pipelined lease requests per scheduling class.")
+_flag("max_pending_lease_requests_per_class", int, 8, "Pipelined lease requests per scheduling class (aligned with worker_pool_max_idle_workers so steady-state bursts cause no worker churn).")
 _flag("worker_pool_max_idle_workers", int, 8, "Idle workers kept warm per node.")
 _flag("worker_pool_idle_ttl_s", int, 300, "Kill idle workers after this long.")
 

@@ -243,3 +243,18 @@ def test_contained_put_refs_released(cluster):
     while time.time() < deadline and k in cw.objects:
         time.sleep(0.1)
     assert k not in cw.objects, "contained put borrow leaked"
+
+
+def test_concurrent_task_burst(cluster):
+    """A burst of concurrent tasks pipelines through cached worker leases
+    (reference: normal_task_submitter.cc lease reuse) — must complete well
+    under per-task worker-spawn time."""
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    t0 = time.time()
+    out = ray_tpu.get([sq.remote(i) for i in range(200)])
+    dt = time.time() - t0
+    assert out == [i * i for i in range(200)]
+    assert dt < 30, f"200-task burst took {dt:.1f}s (lease caching broken?)"
