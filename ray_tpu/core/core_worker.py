@@ -91,10 +91,13 @@ class CoreWorker:
         self._exported_funcs: set = set()
         self._actor_instance: Any = None
         self._actor_id: Optional[bytes] = None
-        # actor-task ordering: caller_id -> next expected seqno / buffer
+        # actor-task ordering: caller_id -> next expected seqno, plus one
+        # event per out-of-order waiter (a CV broadcast is O(waiters) wakeups
+        # per completion — O(n^2) for a deep pipeline; reference:
+        # task_execution/actor_scheduling_queue.cc keys waiters by seqno).
         self._actor_seqno: Dict[bytes, int] = {}
-        self._actor_buffer: Dict[bytes, Dict[int, tuple]] = {}
-        self._actor_cv: Optional[asyncio.Condition] = None
+        self._actor_waiters: Dict[bytes, Dict[int, asyncio.Event]] = {}
+        self._is_actor_worker = False
         self._exec_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task-exec")
         self._worker_clients: Dict[Address, RpcClient] = {}
@@ -695,10 +698,13 @@ class CoreWorker:
                                 fut.set_exception(WorkerCrashedError(
                                     f"node agent unreachable: {errors[0]!r}"))
                         return
+                    await asyncio.sleep(0.05)
                 else:
                     fail_streak = 0
-                if not granted:
-                    await asyncio.sleep(0.05)
+                # No client-side poll on denial: the agent parks denied
+                # requests server-side (lease_queue_wait_ms) and replies
+                # only when granted or its wait budget expires, so looping
+                # immediately is not a busy-poll.
         finally:
             self._class_pumps.pop(key, None)
             # Re-arm if tasks raced in while we were exiting.
@@ -706,34 +712,57 @@ class CoreWorker:
                 self._ensure_pump(key)
 
     async def _lease_runner(self, key: tuple, lease: dict) -> None:
-        """Feed queued tasks of this class through one leased worker
-        sequentially; return the lease when the backlog drains."""
+        """Feed queued tasks of this class through one leased worker with up
+        to ``worker_lease_pipeline_depth`` pushes in flight (the RPC client
+        is multiplexed; execution on the worker stays serial in its exec
+        pool). Pipelining hides per-task RPC latency — the reference gets
+        its small-task throughput the same way (normal_task_submitter.cc
+        pipelines onto cached leases). Returns the lease when the backlog
+        drains or the worker looks broken."""
         q = self._class_queues[key]
         worker_addr = tuple(lease["worker_addr"])
         lease_node = lease.get("spilled_to", self.agent_addr)
         client = self._client_for_worker(worker_addr)
+        depth = max(1, GlobalConfig.worker_lease_pipeline_depth)
+        inflight: set = set()
+        broken = False
         try:
-            while q:
-                spec, fut = q.pop(0)
-                if fut.done():  # cancelled/raced
-                    continue
-                try:
-                    reply = await client.call("push_task",
-                                              cloudpickle.dumps(spec))
-                    self._process_task_reply(spec, reply)
-                    self._release_arg_refs(spec)
-                    fut.set_result(None)
-                except BaseException as e:
-                    if not fut.done():
-                        fut.set_exception(
-                            e if isinstance(e, Exception)
-                            else WorkerCrashedError(repr(e)))
-                    return  # lease's worker is suspect: drop the lease
+            while (q or inflight) and not broken:
+                while q and len(inflight) < depth:
+                    spec, fut = q.pop(0)
+                    if fut.done():  # cancelled/raced
+                        continue
+                    inflight.add(asyncio.ensure_future(
+                        self._push_one(client, spec, fut)))
+                if not inflight:
+                    break
+                done, inflight = await asyncio.wait(
+                    inflight, return_when=asyncio.FIRST_COMPLETED)
+                broken = any(d.result() is False for d in done)
+            if inflight:  # worker suspect: let in-flight pushes settle
+                await asyncio.wait(inflight)
         finally:
             agent = self.agent if tuple(lease_node) == tuple(self.agent_addr) \
                 else self._client_for_worker(tuple(lease_node))
             asyncio.ensure_future(self._return_lease_quiet(
                 agent, lease["lease_id"]))
+
+    async def _push_one(self, client: RpcClient, spec: TaskSpec,
+                        fut: asyncio.Future) -> bool:
+        """Push one task; True on transport success (user errors travel in
+        the reply), False when the worker is suspect."""
+        try:
+            reply = await client.call("push_task", cloudpickle.dumps(spec))
+            self._process_task_reply(spec, reply)
+            self._release_arg_refs(spec)
+            if not fut.done():
+                fut.set_result(None)
+            return True
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e if isinstance(e, Exception)
+                                  else WorkerCrashedError(repr(e)))
+            return False
 
     async def _return_lease_quiet(self, agent: RpcClient, lease_id) -> None:
         try:
@@ -910,24 +939,31 @@ class CoreWorker:
             self._exec_pool, lambda: cls(*args, **kwargs))
         self._actor_instance = instance
         self._actor_id = creation["actor_id"]
-        self._actor_cv = asyncio.Condition()
+        self._is_actor_worker = True
 
     async def push_task(self, spec_blob: bytes) -> dict:
         spec: TaskSpec = cloudpickle.loads(spec_blob)
         if spec.is_actor_task:
             # Enforce per-caller seqno ordering (reference:
-            # task_execution/actor_scheduling_queue.cc).
-            assert self._actor_cv is not None, "not an actor worker"
-            async with self._actor_cv:
-                while spec.seqno != self._actor_seqno.get(spec.caller_id, 0):
-                    await self._actor_cv.wait()
+            # task_execution/actor_scheduling_queue.cc). Each out-of-order
+            # push parks on its own event; completion wakes exactly the
+            # successor seqno.
+            assert self._is_actor_worker, "not an actor worker"
+            if spec.seqno != self._actor_seqno.get(spec.caller_id, 0):
+                ev = asyncio.Event()
+                self._actor_waiters.setdefault(
+                    spec.caller_id, {})[spec.seqno] = ev
+                await ev.wait()
         try:
             return await self._execute(spec)
         finally:
             if spec.is_actor_task:
-                async with self._actor_cv:
-                    self._actor_seqno[spec.caller_id] = spec.seqno + 1
-                    self._actor_cv.notify_all()
+                self._actor_seqno[spec.caller_id] = spec.seqno + 1
+                waiters = self._actor_waiters.get(spec.caller_id)
+                if waiters:
+                    nxt = waiters.pop(spec.seqno + 1, None)
+                    if nxt is not None:
+                        nxt.set()
 
     async def _resolve_args(self, wire_args: list) -> Tuple[list, dict]:
         args: list = []

@@ -193,6 +193,8 @@ class NodeAgent:
             ba = self.bundle_available.get((pg, bundle_index))
             if ba is not None:
                 resources_add(ba, res)
+            async with self._resource_cv:
+                self._resource_cv.notify_all()
         else:
             await self._free_resources(res)
 
@@ -276,47 +278,79 @@ class NodeAgent:
     async def request_lease(self, resources: dict, pg: Optional[bytes] = None,
                             bundle_index: int = -1, strategy=None,
                             _no_spill: bool = False) -> dict:
-        # Placement-group tasks must run on the bundle's node.
-        if pg is not None and (pg, bundle_index) not in self.bundle_available \
-                and not _no_spill:
-            info = await self.controller.call("get_pg_info", pg)
-            if info is None or info["state"] != "CREATED":
-                return {"granted": False, "retry": True}
-            node_id = info["bundle_nodes"][bundle_index if bundle_index >= 0 else 0]
-            if node_id != self.node_id.binary():
-                nodes = await self.controller.call("get_nodes")
-                for n in nodes:
-                    if n["node_id"] == node_id:
-                        return await self._spill_to(tuple(n["addr"]), resources,
-                                                    pg, bundle_index, strategy)
+        """Grant a worker lease, parking the request SERVER-SIDE while
+        resources are busy (reference: cluster_lease_manager.cc queues leases
+        and replies when granted, rather than making clients poll). The
+        request waits up to ``lease_queue_wait_ms`` on the resource condvar;
+        only then does the client see retry=True and re-request."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + GlobalConfig.lease_queue_wait_ms / 1000
+        while True:
+            # Placement-group tasks must run on the bundle's node.
+            if pg is not None and (pg, bundle_index) not in self.bundle_available \
+                    and not _no_spill:
+                info = await self.controller.call("get_pg_info", pg)
+                if info is None or info["state"] != "CREATED":
+                    if not await self._park_until(deadline):
+                        return {"granted": False, "retry": True}
+                    continue
+                node_id = info["bundle_nodes"][bundle_index if bundle_index >= 0 else 0]
+                if node_id != self.node_id.binary():
+                    nodes = await self.controller.call("get_nodes")
+                    for n in nodes:
+                        if n["node_id"] == node_id:
+                            return await self._spill_to(tuple(n["addr"]),
+                                                        resources, pg,
+                                                        bundle_index, strategy)
+                    return {"granted": False, "retry": True}
+
+            avail = (self.bundle_available.get((pg, bundle_index))
+                     if pg is not None else self.resources_available)
+            if avail is not None and resources_fit(avail, resources):
+                resources_sub(avail, resources)
+                try:
+                    w = await self._pop_worker()
+                except Exception as e:
+                    resources_add(avail, resources)
+                    return {"granted": False, "retry": True, "error": repr(e)}
+                self._lease_seq += 1
+                lease_id = self._lease_seq.to_bytes(8, "big") + \
+                    self.node_id.binary()[:8]
+                w.current_lease = lease_id
+                self.leases[lease_id] = (w, dict(resources), pg, bundle_index)
+                return {"granted": True, "lease_id": lease_id,
+                        "worker_addr": w.addr,
+                        "node_id": self.node_id.binary()}
+
+            if not _no_spill and pg is None:
+                # Spillback: ask the controller for a feasible node.
+                pick = await self.controller.call("pick_node", resources,
+                                                  [self.node_id.binary()],
+                                                  strategy)
+                if pick is not None:
+                    return await self._spill_to(tuple(pick["addr"]), resources,
+                                                pg, bundle_index, strategy)
+            # Nothing feasible now: park on the resource condvar until
+            # something frees up or the queue-wait budget expires.
+            if not await self._park_until(deadline):
                 return {"granted": False, "retry": True}
 
-        avail = (self.bundle_available.get((pg, bundle_index))
-                 if pg is not None else self.resources_available)
-        if avail is not None and resources_fit(avail, resources):
-            resources_sub(avail, resources)
+    async def _park_until(self, deadline: float) -> bool:
+        """Wait for a resource-availability change until `deadline`.
+        Returns False once the deadline has passed."""
+        loop = asyncio.get_running_loop()
+        remaining = deadline - loop.time()
+        if remaining <= 0:
+            return False
+        async with self._resource_cv:
             try:
-                w = await self._pop_worker()
-            except Exception as e:
-                resources_add(avail, resources)
-                return {"granted": False, "retry": True, "error": repr(e)}
-            self._lease_seq += 1
-            lease_id = self._lease_seq.to_bytes(8, "big") + self.node_id.binary()[:8]
-            w.current_lease = lease_id
-            self.leases[lease_id] = (w, dict(resources), pg, bundle_index)
-            return {"granted": True, "lease_id": lease_id,
-                    "worker_addr": w.addr, "node_id": self.node_id.binary()}
-
-        if _no_spill or pg is not None:
-            return {"granted": False, "retry": True}
-        # Spillback: ask the controller for a feasible node.
-        pick = await self.controller.call("pick_node", resources,
-                                          [self.node_id.binary()], strategy)
-        if pick is None:
-            # Nothing feasible elsewhere either: wait for local resources.
-            return {"granted": False, "retry": True}
-        return await self._spill_to(tuple(pick["addr"]), resources, pg,
-                                    bundle_index, strategy)
+                # Cap the park so remote state (PG creation, spillback
+                # candidates) is re-checked even without a local notify.
+                await asyncio.wait_for(self._resource_cv.wait(),
+                                       min(remaining, 0.25))
+            except asyncio.TimeoutError:
+                pass
+        return True
 
     async def _spill_to(self, addr: Address, resources, pg, bundle_index,
                         strategy) -> dict:
@@ -333,12 +367,7 @@ class NodeAgent:
             return
         w, res, pg, bundle_index = lease
         w.current_lease = None
-        if pg is not None:
-            ba = self.bundle_available.get((pg, bundle_index))
-            if ba is not None:
-                resources_add(ba, res)
-        elif res:
-            await self._free_resources(res)
+        await self._return_resources(res, pg, bundle_index)
         self._push_idle(w)
 
     # ------------------------------------------------------------------
@@ -356,6 +385,8 @@ class NodeAgent:
         res = self.bundles.get(pg_id, {}).get(index)
         if res is not None:
             self.bundle_available[(pg_id, index)] = dict(res)
+            async with self._resource_cv:
+                self._resource_cv.notify_all()
 
     async def return_bundle(self, pg_id: bytes, index: int) -> None:
         res = self.bundles.get(pg_id, {}).pop(index, None)

@@ -71,6 +71,8 @@ _flag("object_spill_dir", str, "", "Directory for spilled objects (default: sess
 # --- scheduling ---
 _flag("scheduler_spread_threshold", float, 0.5, "Hybrid policy: pack below this utilization, then spread.")
 _flag("max_pending_lease_requests_per_class", int, 8, "Pipelined lease requests per scheduling class (aligned with worker_pool_max_idle_workers so steady-state bursts cause no worker churn).")
+_flag("lease_queue_wait_ms", int, 1000, "Server-side park time for an unsatisfiable lease request before the client must re-request (kills client-side poll loops).")
+_flag("worker_lease_pipeline_depth", int, 16, "Task pushes kept in flight per leased worker (hides RPC latency; execution on the worker stays serial).")
 _flag("worker_pool_max_idle_workers", int, 8, "Idle workers kept warm per node.")
 _flag("worker_pool_idle_ttl_s", int, 300, "Kill idle workers after this long.")
 
