@@ -9,16 +9,16 @@ from ray_tpu.version import __version__
 
 _API_NAMES = (
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "get_actor", "placement_group", "remove_placement_group",
-    "PlacementGroup", "nodes", "cluster_resources", "available_resources",
-    "ObjectRef", "ActorHandle",
+    "kill", "cancel", "get_actor", "placement_group",
+    "remove_placement_group", "PlacementGroup", "nodes", "cluster_resources",
+    "available_resources", "ObjectRef", "ActorHandle", "ObjectRefGenerator",
 )
 
 
 def __getattr__(name):
     # Lazy: importing ray_tpu stays light; the runtime loads on first API use.
     if name in _API_NAMES:
-        if name in ("ObjectRef", "ActorHandle"):
+        if name in ("ObjectRef", "ActorHandle", "ObjectRefGenerator"):
             from ray_tpu.core import ref as _ref
             return getattr(_ref, name)
         from ray_tpu import api as _api

@@ -99,16 +99,19 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         opts = self._opts
+        num_returns = opts.get("num_returns", 1)
         refs = _cw().submit_task(
             self._func, args, kwargs,
-            num_returns=opts.get("num_returns", 1),
+            num_returns=num_returns,
             resources=_resources_from_opts(opts),
             max_retries=opts.get("max_retries", 0),
             placement_group=_pg_id(opts.get("placement_group")),
             pg_bundle_index=opts.get("placement_group_bundle_index", -1),
             scheduling_strategy=opts.get("scheduling_strategy"),
             name=opts.get("name", ""))
-        return refs[0] if opts.get("num_returns", 1) == 1 else refs
+        if num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
+        return refs[0] if num_returns == 1 else refs
 
     def options(self, **opts):
         merged = dict(self._opts)
@@ -184,6 +187,14 @@ def put(value: Any) -> ObjectRef:
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None) -> Tuple[list, list]:
     return _cw().wait(refs, num_returns, timeout)
+
+
+def cancel(target, *, force: bool = False) -> None:
+    """Cancel a task by ObjectRef or ObjectRefGenerator (mirrors reference
+    ray.cancel, python/ray/_private/worker.py:3268). Queued tasks are
+    dropped; running tasks get TaskCancelledError raised in their exec
+    thread; force=True kills the executing worker."""
+    _cw().cancel(target, force)
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:

@@ -52,6 +52,10 @@ class TaskSpec:
     owner_addr: Address
     owner_worker_id: bytes
     job_id: bytes = b"\x00" * 4
+    # Streaming generator task: yields are reported to the owner one at a
+    # time (reference: _raylet.pyx:297 ObjectRefGenerator + task_manager.cc
+    # ObjectRefStream); num_returns is ignored when True.
+    streaming: bool = False
     # actor fields
     actor_id: Optional[bytes] = None           # target actor for method calls
     actor_creation: Optional[dict] = None      # creation spec (max_restarts...)
@@ -110,6 +114,11 @@ class ActorDiedError(ActorError):
 
 
 class ObjectLostError(RayTpuError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    """Raised at ray.get on a task cancelled via ray_tpu.cancel()."""
     pass
 
 

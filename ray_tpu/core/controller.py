@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.common import (ActorState, Address, NodeState, PGState,
                                  resources_add, resources_fit, resources_sub)
+from ray_tpu.core.pubsub import PubsubHub
 from ray_tpu.core.rpc import RpcClient, RpcServer
 from ray_tpu.utils import get_logger
 from ray_tpu.utils.config import GlobalConfig
@@ -88,6 +89,25 @@ class Controller:
         self._next_job = 1
         self._health_task: Optional[asyncio.Task] = None
         self._node_seq = 0  # round-robin cursor for SPREAD
+        # Long-poll pubsub hub (reference: gcs pubsub_handler.cc). Channels:
+        #   node_events  — {"type": "added"|"dead", "node_id", "addr"}
+        #   actor_events — {"actor_id", "state", "addr", "death_reason"}
+        #   log_events   — driver-facing error/log lines
+        self.pubsub = PubsubHub()
+
+    # ------------------------------------------------------------------
+    # pubsub
+    # ------------------------------------------------------------------
+    async def pubsub_poll(self, channel: str, from_seq: int,
+                          timeout: float = 30.0) -> dict:
+        return await self.pubsub.poll(channel, from_seq, min(timeout, 60.0))
+
+    def _publish_actor_event(self, e: "ActorEntry") -> None:
+        self.pubsub.publish("actor_events", {
+            "actor_id": e.actor_id, "state": e.state, "addr": e.addr,
+            "death_reason": e.death_reason,
+            "incarnation": e.restarts_used,
+        })
 
     # ------------------------------------------------------------------
     # node management
@@ -98,6 +118,8 @@ class Controller:
         self.nodes[node_id] = NodeEntry(node_id, addr, resources, labels)
         logger.info("node registered %s addr=%s resources=%s",
                     node_id.hex()[:8], addr, resources)
+        self.pubsub.publish("node_events", {
+            "type": "added", "node_id": node_id, "addr": addr})
         return {"num_nodes": len(self.nodes)}
 
     async def heartbeat(self, node_id: bytes, resources_available: dict) -> bool:
@@ -131,18 +153,11 @@ class Controller:
                     ActorState.ALIVE, ActorState.PENDING):
                 asyncio.ensure_future(self._handle_actor_failure(
                     actor, f"node died: {reason}"))
-        # Broadcast to remaining agents (object copies on that node are gone).
-        for other in self.nodes.values():
-            if other.state == NodeState.ALIVE:
-                asyncio.ensure_future(self._notify(
-                    other, "node_dead", node_id))
-
-    async def _notify(self, node: NodeEntry, method: str, *args) -> None:
-        try:
-            await node.client.call(method, *args)
-        except Exception as e:
-            logger.debug("notify %s to %s failed: %r", method,
-                         node.node_id.hex()[:8], e)
+        # Remaining agents learn via their node_events subscription
+        # (object copies on that node are gone).
+        self.pubsub.publish("node_events", {
+            "type": "dead", "node_id": node_id, "addr": node.addr,
+            "reason": reason})
 
     async def _health_loop(self) -> None:
         period = GlobalConfig.health_check_period_ms / 1000
@@ -252,6 +267,7 @@ class Controller:
                     entry.node_id = node.node_id
                     entry.state = ActorState.ALIVE
                     entry.event.set()
+                    self._publish_actor_event(entry)
                     return
                 except Exception as e:
                     logger.warning("actor %s failed to start on %s: %r",
@@ -262,6 +278,7 @@ class Controller:
         entry.state = ActorState.DEAD
         entry.death_reason = "could not schedule actor (no feasible node)"
         entry.event.set()
+        self._publish_actor_event(entry)
 
     async def report_actor_death(self, actor_id: bytes, reason: str) -> None:
         entry = self.actors.get(actor_id)
@@ -280,11 +297,13 @@ class Controller:
             logger.info("restarting actor %s (%d/%s): %s",
                         entry.actor_id.hex()[:8], entry.restarts_used,
                         entry.max_restarts, reason)
+            self._publish_actor_event(entry)
             await self._schedule_actor(entry)
         else:
             entry.state = ActorState.DEAD
             entry.death_reason = reason
             entry.event.set()
+            self._publish_actor_event(entry)
             if entry.name:
                 self.named_actors.pop(entry.name, None)
 
@@ -305,6 +324,7 @@ class Controller:
             entry.state = ActorState.DEAD
             entry.death_reason = "killed via kill_actor"
             entry.event.set()
+            self._publish_actor_event(entry)
             if entry.name:
                 self.named_actors.pop(entry.name, None)
 

@@ -34,8 +34,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from ray_tpu.core import serialization
-from ray_tpu.core.common import (Address, GetTimeoutError, ObjectLostError,
-                                 TaskError, TaskSpec, WorkerCrashedError)
+from ray_tpu.core.common import (ActorState, Address, GetTimeoutError,
+                                 ObjectLostError, TaskError, TaskSpec,
+                                 WorkerCrashedError)
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import MappedObject
 from ray_tpu.core.ref import ActorHandle, ObjectRef, set_core_worker
@@ -68,6 +69,24 @@ class ObjectEntry:
         self.contained: list = []
 
 
+class _StreamState:
+    """Owner-side ledger for one streaming task (reference:
+    task_manager.cc ObjectRefStream)."""
+
+    __slots__ = ("refs", "produced", "consumed", "total", "error", "event",
+                 "bp_event", "released")
+
+    def __init__(self):
+        self.refs: Dict[int, "ObjectRef"] = {}
+        self.produced = 0          # highest index+1 reported
+        self.consumed = 0          # highest index+1 handed to the consumer
+        self.total: Optional[int] = None
+        self.error: Optional[BaseException] = None
+        self.event: Optional[asyncio.Event] = None   # consumer waiting
+        self.bp_event: Optional[asyncio.Event] = None  # producer parked
+        self.released = False
+
+
 class CoreWorker:
     def __init__(self, mode: str, agent_addr: Address,
                  controller_addr: Address, session_dir: str = "/tmp"):
@@ -98,8 +117,10 @@ class CoreWorker:
         self._actor_seqno: Dict[bytes, int] = {}
         self._actor_waiters: Dict[bytes, Dict[int, asyncio.Event]] = {}
         self._is_actor_worker = False
+        self._exec_thread_id: Optional[int] = None
         self._exec_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="task-exec")
+            max_workers=1, thread_name_prefix="task-exec",
+            initializer=self._record_exec_thread)
         self._worker_clients: Dict[Address, RpcClient] = {}
         # actor_id -> (addr, client, incarnation)
         self._actor_clients: Dict[bytes, Tuple[Address, RpcClient, int]] = {}
@@ -110,6 +131,10 @@ class CoreWorker:
         # the SAME incarnation never resets the seqno stream.
         self._actor_seq_out: Dict[bytes, int] = {}
         self._actor_incarnation: Dict[bytes, int] = {}
+        # Actor-state pubsub: terminal deaths observed on the controller's
+        # actor_events channel (fail-fast without a wait_actor_ready RPC).
+        self._actor_deaths: Dict[bytes, str] = {}
+        self._actor_sub = None
         # task_id -> ObjectRefs held for that task's args (incl. refs
         # contained inside inline values and promoted big args).
         self._task_arg_refs: Dict[bytes, List[ObjectRef]] = {}
@@ -117,6 +142,14 @@ class CoreWorker:
         # pinned for the actor's lifetime (restarts re-resolve them),
         # released when the actor is killed or observed dead.
         self._actor_arg_refs: Dict[bytes, List[ObjectRef]] = {}
+        # Streaming-generator task state (owner side), keyed by task_id.
+        self._streams: Dict[bytes, _StreamState] = {}
+        # Cancellation: task_ids cancelled by the user; where tasks execute.
+        self._cancelled: set = set()
+        self._task_exec_addr: Dict[bytes, Address] = {}
+        # Worker-side cancellation: task_ids to skip/interrupt.
+        self._exec_cancelled: set = set()
+        self._exec_current: Optional[bytes] = None
         # Lease-cached dispatch state, per scheduling class.
         self._class_queues: Dict[tuple, list] = {}
         self._class_pumps: Dict[tuple, asyncio.Task] = {}
@@ -321,6 +354,105 @@ class CoreWorker:
 
     async def ping(self) -> str:
         return "pong"
+
+    # ------------------------------------------------------------------
+    # streaming generators (owner side; reference: task_manager.cc
+    # HandleReportGeneratorItemReturns + ObjectRefStream)
+    # ------------------------------------------------------------------
+    async def report_streamed_return(self, task_id: bytes, index: int,
+                                     kind: str, data, meta, node_id,
+                                     addr, size: int) -> dict:
+        st = self._streams.get(task_id)
+        if st is None or st.released:
+            # Consumer gone: tell the producer to stop.
+            return {"accepted": False}
+        oid = ObjectID.for_task_return(TaskID(task_id), index).binary()
+        # Accept an index unless it is already recorded (in st.refs) or was
+        # already handed to the consumer (< st.consumed) — reports can
+        # arrive out of order (a big item's store-put overlaps the next
+        # item's inline report), and a retried worker re-emits from 0.
+        if index >= st.consumed and index not in st.refs:
+            ref = ObjectRef(ObjectID(oid), self.address)
+            self.add_local_ref(ref)  # held for the consumer until handed out
+            st.refs[index] = ref
+            if kind == "inline":
+                self._mark_ready_inline(oid, data, meta)
+            else:
+                self._mark_ready_stored(oid, node_id, tuple(addr), size)
+            st.produced = max(st.produced, index + 1)
+            if st.event is not None:
+                st.event.set()
+        # Backpressure: park this report's reply while the consumer lags
+        # more than the window (the producer's send window stalls on it).
+        window = GlobalConfig.streaming_generator_backpressure_items
+        while (not st.released and st.error is None
+               and index + 1 - st.consumed > window):
+            if st.bp_event is None or st.bp_event.is_set():
+                st.bp_event = asyncio.Event()
+            await st.bp_event.wait()
+        return {"accepted": not st.released}
+
+    async def _next_stream_item_async(self, task_id: bytes, index: int,
+                                      timeout: Optional[float] = None):
+        st = self._streams.get(task_id)
+        if st is None:
+            return None  # exhausted or released: iterator semantics
+        deadline = None if timeout is None else \
+            asyncio.get_running_loop().time() + timeout
+        while True:
+            if index < st.produced and index in st.refs:
+                st.consumed = max(st.consumed, index + 1)
+                if st.bp_event is not None:
+                    st.bp_event.set()
+                return st.refs.pop(index)
+            if st.error is not None:
+                raise st.error
+            if st.total is not None and index >= st.total:
+                self._streams.pop(task_id, None)
+                return None
+            if st.event is None or st.event.is_set():
+                st.event = asyncio.Event()
+            if deadline is None:
+                await st.event.wait()
+            else:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    raise GetTimeoutError("stream item timed out")
+                try:
+                    await asyncio.wait_for(st.event.wait(), remaining)
+                except asyncio.TimeoutError:
+                    raise GetTimeoutError("stream item timed out") from None
+
+    def next_stream_item(self, task_id: bytes, index: int,
+                         timeout: Optional[float] = None):
+        return self._run(
+            self._next_stream_item_async(task_id, index, timeout)).result()
+
+    async def next_stream_item_async(self, task_id: bytes, index: int):
+        """Variant for async consumers on THEIR OWN event loop (Serve
+        replicas): the wait still runs on the core-worker io loop (stream
+        events are not thread-safe across loops); the caller's loop awaits
+        the bridged future."""
+        return await asyncio.wrap_future(
+            self._run(self._next_stream_item_async(task_id, index)))
+
+    def release_stream(self, task_id: bytes) -> None:
+        st = self._streams.pop(task_id, None)
+        if st is None:
+            return
+        st.released = True
+
+        def _drop():
+            if st.bp_event is not None:
+                st.bp_event.set()
+            for ref in st.refs.values():
+                self.remove_local_ref(ref)
+            st.refs.clear()
+
+        try:
+            self._loop.call_soon_threadsafe(_drop)
+        except RuntimeError:
+            pass  # loop shut down
 
     # ------------------------------------------------------------------
     # put / get / wait
@@ -559,10 +691,11 @@ class CoreWorker:
             return ("r", oid.binary(), self.address)
         return ("v", sv.to_bytes(), sv.meta())
 
-    def submit_task(self, func, args, kwargs, *, num_returns: int = 1,
+    def submit_task(self, func, args, kwargs, *, num_returns=1,
                     resources: Optional[dict] = None, max_retries: int = 0,
                     placement_group=None, pg_bundle_index: int = -1,
-                    scheduling_strategy=None, name: str = "") -> List[ObjectRef]:
+                    scheduling_strategy=None, name: str = ""):
+        streaming = num_returns == "streaming"
         func_id = self._export_function(func)
         task_id = TaskID.random()
         held: List[ObjectRef] = []
@@ -571,7 +704,8 @@ class CoreWorker:
             name=name or getattr(func, "__name__", "task"),
             func_id=func_id,
             args=self._serialize_args(args, kwargs, held),
-            num_returns=num_returns,
+            num_returns=1 if streaming else num_returns,
+            streaming=streaming,
             resources=resources or {"CPU": 1.0},
             owner_addr=self.address,
             owner_worker_id=self.worker_id.binary(),
@@ -581,6 +715,11 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy,
         )
         self._task_arg_refs[task_id.binary()] = held
+        if streaming:
+            from ray_tpu.core.ref import ObjectRefGenerator
+            self._streams[task_id.binary()] = _StreamState()
+            self._run(self._submit_and_track(spec))
+            return ObjectRefGenerator(task_id.binary())
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(task_id, i)
@@ -596,20 +735,38 @@ class CoreWorker:
         try:
             await self._submit_with_retries(spec)
         except BaseException as e:  # mark all returns failed
-            for i in range(spec.num_returns):
-                oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
-                self._mark_error(oid.binary(), e if isinstance(e, Exception)
-                                 else WorkerCrashedError(repr(e)))
+            err = e if isinstance(e, Exception) else WorkerCrashedError(repr(e))
+            if spec.streaming:
+                self._fail_stream(spec.task_id, err)
+            else:
+                for i in range(spec.num_returns):
+                    oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+                    self._mark_error(oid.binary(), err)
             self._release_arg_refs(spec)
 
+    def _fail_stream(self, task_id: bytes, err: BaseException) -> None:
+        st = self._streams.get(task_id)
+        if st is not None:
+            st.error = err
+            if st.event is not None:
+                st.event.set()
+            if st.bp_event is not None:
+                st.bp_event.set()
+
     async def _submit_with_retries(self, spec: TaskSpec) -> None:
+        from ray_tpu.core.common import TaskCancelledError
         attempts = spec.max_retries + 1
         last_exc: Optional[BaseException] = None
         for attempt in range(attempts):
+            if spec.task_id in self._cancelled:
+                raise TaskCancelledError(f"task {spec.name} cancelled")
             try:
                 await self._submit_once(spec)
                 return
             except (RpcConnectionLost, WorkerCrashedError, OSError) as e:
+                if spec.task_id in self._cancelled:
+                    raise TaskCancelledError(
+                        f"task {spec.name} cancelled") from None
                 last_exc = e
                 spec.retry_count += 1
                 await asyncio.sleep(GlobalConfig.task_retry_delay_ms / 1000)
@@ -751,6 +908,7 @@ class CoreWorker:
                         fut: asyncio.Future) -> bool:
         """Push one task; True on transport success (user errors travel in
         the reply), False when the worker is suspect."""
+        self._task_exec_addr[spec.task_id] = tuple(client._address)
         try:
             reply = await client.call("push_task", cloudpickle.dumps(spec))
             self._process_task_reply(spec, reply)
@@ -763,6 +921,8 @@ class CoreWorker:
                 fut.set_exception(e if isinstance(e, Exception)
                                   else WorkerCrashedError(repr(e)))
             return False
+        finally:
+            self._task_exec_addr.pop(spec.task_id, None)
 
     async def _return_lease_quiet(self, agent: RpcClient, lease_id) -> None:
         try:
@@ -771,6 +931,7 @@ class CoreWorker:
             pass
 
     def _release_arg_refs(self, spec: TaskSpec) -> None:
+        self._cancelled.discard(spec.task_id)  # settled: prune bookkeeping
         for ref in self._task_arg_refs.pop(spec.task_id, ()):
             self.remove_local_ref(ref)
 
@@ -783,9 +944,19 @@ class CoreWorker:
         if reply.get("error") is not None:
             err = serialization.deserialize(reply["error"],
                                             reply["error_meta"])
+            if spec.streaming:
+                self._fail_stream(spec.task_id, err)
+                return
             for i in range(spec.num_returns):
                 oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
                 self._mark_error(oid.binary(), err)
+            return
+        if spec.streaming:
+            st = self._streams.get(spec.task_id)
+            if st is not None:
+                st.total = reply["streamed_total"]
+                if st.event is not None:
+                    st.event.set()
             return
         for i, ret in enumerate(reply["returns"]):
             oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
@@ -794,6 +965,44 @@ class CoreWorker:
             else:  # ("stored", node_id, agent_addr, size)
                 self._mark_ready_stored(oid.binary(), ret[1], tuple(ret[2]),
                                         ret[3])
+
+    # ------------------------------------------------------------------
+    # cancellation (owner side; reference: core_worker.cc CancelTask)
+    # ------------------------------------------------------------------
+    def cancel(self, target, force: bool = False) -> None:
+        """Cancel a task by its ObjectRef or ObjectRefGenerator. Queued
+        tasks are dropped; a running task gets TaskCancelledError raised
+        in its exec thread (force=True kills the worker process)."""
+        from ray_tpu.core.ref import ObjectRefGenerator
+        if isinstance(target, ObjectRefGenerator):
+            task_id = target.task_id
+        else:
+            task_id = ObjectID(target.binary()).task_id().binary()
+        self._run(self._cancel_async(task_id, force)).result()
+
+    async def _cancel_async(self, task_id: bytes, force: bool) -> None:
+        from ray_tpu.core.common import TaskCancelledError
+        if (task_id not in self._task_arg_refs
+                and task_id not in self._streams):
+            return  # already settled: nothing to cancel (and nothing leaks)
+        self._cancelled.add(task_id)
+        err = TaskCancelledError(f"task {TaskID(task_id)} cancelled")
+        # Drop from any scheduling-class queue (not yet pushed).
+        for q in self._class_queues.values():
+            for item in list(q):
+                spec, fut = item
+                if spec.task_id == task_id:
+                    q.remove(item)
+                    if not fut.done():
+                        fut.set_exception(err)
+        # Interrupt if already executing somewhere.
+        addr = self._task_exec_addr.get(task_id)
+        if addr is not None:
+            try:
+                await self._client_for_worker(addr).call(
+                    "cancel_task", task_id, force)
+            except Exception:
+                pass  # dead (force) or unreachable: push path surfaces it
 
     async def _resubmit_task(self, e: ObjectEntry) -> None:
         """Lineage reconstruction: re-run the creating task."""
@@ -818,6 +1027,7 @@ class CoreWorker:
                      pg_bundle_index: int = -1,
                      runtime_env: Optional[dict] = None) -> ActorHandle:
         actor_id = ActorID.random()
+        self._ensure_actor_sub()
         held: List[ObjectRef] = []
         creation = {
             "cls_blob": cloudpickle.dumps(cls),
@@ -839,8 +1049,10 @@ class CoreWorker:
                            max_task_retries)
 
     def submit_actor_task(self, handle: ActorHandle, method: str, args,
-                          kwargs, *, num_returns: int = 1) -> ObjectRef:
+                          kwargs, *, num_returns=1):
         actor_id = handle.actor_id.binary()
+        self._ensure_actor_sub()
+        streaming = num_returns == "streaming"
         task_id = TaskID.random()
         held: List[ObjectRef] = []
         spec = TaskSpec(
@@ -848,7 +1060,8 @@ class CoreWorker:
             name=f"{handle._name}.{method}",
             func_id=b"",
             args=self._serialize_args(args, kwargs, held),
-            num_returns=num_returns,
+            num_returns=1 if streaming else num_returns,
+            streaming=streaming,
             resources={},
             owner_addr=self.address,
             owner_worker_id=self.worker_id.binary(),
@@ -859,6 +1072,11 @@ class CoreWorker:
             max_retries=handle._max_task_retries,
         )
         self._task_arg_refs[task_id.binary()] = held
+        if streaming:
+            from ray_tpu.core.ref import ObjectRefGenerator
+            self._streams[task_id.binary()] = _StreamState()
+            self._run(self._submit_actor_and_track(spec))
+            return ObjectRefGenerator(task_id.binary())
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(task_id, i)
@@ -873,14 +1091,53 @@ class CoreWorker:
         try:
             await self._submit_actor_with_retries(spec)
         except BaseException as e:
-            for i in range(spec.num_returns):
-                oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
-                self._mark_error(oid.binary(), e if isinstance(e, Exception)
-                                 else WorkerCrashedError(repr(e)))
+            err = e if isinstance(e, Exception) else WorkerCrashedError(repr(e))
+            if spec.streaming:
+                self._fail_stream(spec.task_id, err)
+            else:
+                for i in range(spec.num_returns):
+                    oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+                    self._mark_error(oid.binary(), err)
             self._release_arg_refs(spec)
+
+    def _ensure_actor_sub(self) -> None:
+        """Subscribe (once) to controller actor-state events so deaths and
+        restarts are pushed instead of discovered via failed RPCs."""
+        if self._actor_sub is not None:
+            return
+        from ray_tpu.core.pubsub import Subscription
+
+        def on_event(ev: dict) -> None:
+            actor_id = ev["actor_id"]
+            known = (actor_id in self._actor_incarnation
+                     or actor_id in self._actor_clients
+                     or actor_id in self._actor_arg_refs)
+            if not known:
+                return
+            if ev["state"] == ActorState.DEAD:
+                self._actor_clients.pop(actor_id, None)
+                while len(self._actor_deaths) >= 4096:  # bounded bookkeeping
+                    self._actor_deaths.pop(next(iter(self._actor_deaths)))
+                self._actor_deaths[actor_id] = ev.get("death_reason", "")
+                self.release_actor_arg_refs(actor_id)
+            elif ev["state"] == ActorState.RESTARTING:
+                # Stale address: drop so the next submit re-resolves.
+                self._actor_clients.pop(actor_id, None)
+
+        self._actor_sub = Subscription(self.controller, "actor_events",
+                                       on_event)
+        self._run(self._start_actor_sub())
+
+    async def _start_actor_sub(self) -> None:
+        if self._actor_sub is not None:
+            self._actor_sub.start()
 
     async def _actor_client(self, actor_id: bytes,
                             refresh: bool = False) -> RpcClient:
+        if actor_id in self._actor_deaths:
+            from ray_tpu.core.common import ActorDiedError
+            raise ActorDiedError(
+                f"actor is DEAD: {self._actor_deaths[actor_id]}")
         cached = None if refresh else self._actor_clients.get(actor_id)
         if cached is not None:
             return cached[1]
@@ -902,18 +1159,24 @@ class CoreWorker:
         return client
 
     async def _submit_actor_with_retries(self, spec: TaskSpec) -> None:
-        from ray_tpu.core.common import ActorDiedError
+        from ray_tpu.core.common import ActorDiedError, TaskCancelledError
         attempts = spec.max_retries + 1
         last: Optional[BaseException] = None
         for attempt in range(attempts):
+            if spec.task_id in self._cancelled:
+                raise TaskCancelledError(f"task {spec.name} cancelled")
             try:
                 client = await self._actor_client(spec.actor_id,
                                                   refresh=attempt > 0)
                 # Assign the per-incarnation send seqno at push time.
                 spec.seqno = self._actor_seq_out.get(spec.actor_id, 0)
                 self._actor_seq_out[spec.actor_id] = spec.seqno + 1
-                reply = await client.call("push_task",
-                                          cloudpickle.dumps(spec))
+                self._task_exec_addr[spec.task_id] = tuple(client._address)
+                try:
+                    reply = await client.call("push_task",
+                                              cloudpickle.dumps(spec))
+                finally:
+                    self._task_exec_addr.pop(spec.task_id, None)
                 self._process_task_reply(spec, reply)
                 self._release_arg_refs(spec)
                 return
@@ -940,6 +1203,26 @@ class CoreWorker:
         self._actor_instance = instance
         self._actor_id = creation["actor_id"]
         self._is_actor_worker = True
+
+    def _record_exec_thread(self) -> None:
+        self._exec_thread_id = threading.get_ident()
+
+    async def cancel_task(self, task_id: bytes, force: bool = False) -> bool:
+        """Cancel an incoming/running task on THIS worker (reference:
+        core_worker.cc HandleCancelTask). Non-force interrupts pure-Python
+        user code by raising TaskCancelledError in the exec thread; force
+        kills the worker process."""
+        if force:
+            os._exit(1)
+        self._exec_cancelled.add(task_id)
+        if self._exec_current == task_id and self._exec_thread_id is not None:
+            import ctypes
+            from ray_tpu.core.common import TaskCancelledError
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(self._exec_thread_id),
+                ctypes.py_object(TaskCancelledError))
+            return True  # interrupted the running task
+        return False  # queued/unknown: the exec-entry flag check handles it
 
     async def push_task(self, spec_blob: bytes) -> dict:
         spec: TaskSpec = cloudpickle.loads(spec_blob)
@@ -992,19 +1275,49 @@ class CoreWorker:
     async def _execute(self, spec: TaskSpec) -> dict:
         loop = asyncio.get_running_loop()
         try:
+            if spec.task_id in self._exec_cancelled:
+                self._exec_cancelled.discard(spec.task_id)
+                from ray_tpu.core.common import TaskCancelledError
+                raise TaskCancelledError(f"task {spec.name} cancelled")
             args, kwargs = await self._resolve_args(spec.args)
             if spec.is_actor_task:
                 method = getattr(self._actor_instance, spec.method_name)
-                fn = lambda: method(*args, **kwargs)  # noqa: E731
+                user_fn = lambda: method(*args, **kwargs)  # noqa: E731
             else:
                 func = await self._load_function(spec.func_id)
-                fn = lambda: func(*args, **kwargs)  # noqa: E731
+                user_fn = lambda: func(*args, **kwargs)  # noqa: E731
+
+            # _exec_current must be set by the EXEC THREAD itself: with
+            # pipelined dispatch several _execute coroutines are alive at
+            # once and a coroutine-side marker would track the wrong task
+            # (cancel would then interrupt an unrelated task). The cancel
+            # flag is re-checked here too — a cancel can land while the
+            # task is parked in the exec pool behind another task.
+            def fn():
+                self._exec_current = spec.task_id
+                try:
+                    if spec.task_id in self._exec_cancelled:
+                        from ray_tpu.core.common import TaskCancelledError
+                        raise TaskCancelledError(
+                            f"task {spec.name} cancelled")
+                    return user_fn()
+                finally:
+                    self._exec_current = None
+
+            if spec.streaming:
+                return await self._execute_streaming(spec, user_fn)
             result = await loop.run_in_executor(self._exec_pool, fn)
         except BaseException as e:  # user error -> error payload to owner
+            from ray_tpu.core.common import TaskCancelledError
             tb = traceback.format_exc()
-            err = TaskError(repr(e), tb)
+            if isinstance(e, TaskCancelledError):
+                err: BaseException = e  # surfaces as-is at ray.get
+            else:
+                err = TaskError(repr(e), tb)
             sv = serialization.serialize_error(err)
             return {"error": sv.to_bytes(), "error_meta": sv.meta()}
+        finally:
+            self._exec_cancelled.discard(spec.task_id)
 
         results = (result,) if spec.num_returns == 1 else tuple(result)
         returns = []
@@ -1018,6 +1331,81 @@ class CoreWorker:
                 returns.append(("stored", self.node_id, self.agent_addr,
                                 sv.total_size))
         return {"error": None, "returns": returns}
+
+    async def _execute_streaming(self, spec: TaskSpec, fn) -> dict:
+        """Run a generator task: the exec thread pulls items from the user
+        generator and emits each to the owner as its own return object,
+        with a small send window; the owner's report handler parks its
+        reply for consumer backpressure (reference:
+        task_manager.cc HandleReportGeneratorItemReturns +
+        generator_waiter.cc)."""
+        from ray_tpu.core.common import TaskCancelledError
+        loop = asyncio.get_running_loop()
+        owner = self._client_for_worker(tuple(spec.owner_addr))
+
+        def run_gen() -> int:
+            from collections import deque
+            self._exec_current = spec.task_id
+            try:
+                if spec.task_id in self._exec_cancelled:
+                    raise TaskCancelledError(f"task {spec.name} cancelled")
+                gen = fn()
+                if not hasattr(gen, "__iter__"):
+                    raise TypeError(
+                        f"streaming task {spec.name} must return an "
+                        f"iterable, got {type(gen).__name__}")
+                pending = deque()
+                count = 0
+                consumer_gone = False
+                for item in gen:
+                    sv = serialization.serialize(item)
+                    pending.append(asyncio.run_coroutine_threadsafe(
+                        self._emit_stream_item(owner, spec, count, sv), loop))
+                    count += 1
+                    while len(pending) >= 4:  # send window
+                        if not pending.popleft().result():
+                            consumer_gone = True
+                            break
+                    if consumer_gone:
+                        break
+                    if spec.task_id in self._exec_cancelled:
+                        raise TaskCancelledError(
+                            f"task {spec.name} cancelled")
+                close = getattr(gen, "close", None)
+                if close is not None:
+                    close()
+                while pending:
+                    pending.popleft().result()
+                return count
+            finally:
+                self._exec_current = None
+
+        try:
+            total = await loop.run_in_executor(self._exec_pool, run_gen)
+        except BaseException as e:
+            tb = traceback.format_exc()
+            err = e if isinstance(e, TaskCancelledError) else \
+                TaskError(repr(e), tb)
+            sv = serialization.serialize_error(err)
+            return {"error": sv.to_bytes(), "error_meta": sv.meta()}
+        finally:
+            self._exec_cancelled.discard(spec.task_id)
+        return {"error": None, "streamed_total": total}
+
+    async def _emit_stream_item(self, owner: RpcClient, spec: TaskSpec,
+                                index: int, sv) -> bool:
+        """Report one yielded item to the owner; False = consumer gone."""
+        if sv.total_size <= GlobalConfig.max_direct_call_object_size:
+            reply = await owner.call(
+                "report_streamed_return", spec.task_id, index, "inline",
+                sv.to_bytes(), sv.meta(), None, None, 0)
+        else:
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), index)
+            await self._store_put(oid.binary(), sv)
+            reply = await owner.call(
+                "report_streamed_return", spec.task_id, index, "stored",
+                None, None, self.node_id, self.agent_addr, sv.total_size)
+        return bool(reply.get("accepted"))
 
     # ------------------------------------------------------------------
     def shutdown(self) -> None:

@@ -33,6 +33,7 @@ from ray_tpu.core.common import (Address, resources_add, resources_fit,
                                  resources_sub)
 from ray_tpu.core.ids import NodeID, ObjectID
 from ray_tpu.core.object_store import LocalObjectStore
+from ray_tpu.core.pubsub import Subscription
 from ray_tpu.core.rpc import RpcClient, RpcServer
 from ray_tpu.utils import get_logger
 from ray_tpu.utils.config import GlobalConfig
@@ -136,6 +137,11 @@ class NodeAgent:
             self.resources_total, self.labels)
         asyncio.ensure_future(self._heartbeat_loop())
         asyncio.ensure_future(self._reap_loop())
+        # Cluster membership via controller pubsub (reference: raylets
+        # subscribe to GCS node-info channel, not direct RPC pushes).
+        self._node_sub = Subscription(
+            self.controller, "node_events", self._on_node_event,
+            from_latest=True).start()
         logger.info("node agent %s on %s:%d resources=%s",
                     self.node_id.hex()[:8], self.host, self.port,
                     self.resources_total)
@@ -596,8 +602,17 @@ class NodeAgent:
     # ------------------------------------------------------------------
     # notifications / state
     # ------------------------------------------------------------------
-    async def node_dead(self, node_id: bytes) -> None:
-        pass  # locations are owner-tracked; nothing node-local to clean
+    async def _on_node_event(self, event: dict) -> None:
+        if event.get("type") == "dead":
+            # Locations are owner-tracked; drop the dead peer's RPC client
+            # so pulls stop targeting it.
+            addr = tuple(event.get("addr") or ())
+            client = self._peer_clients.pop(addr, None)
+            if client is not None:
+                try:
+                    await client.close()
+                except Exception:
+                    pass
 
     async def agent_stats(self) -> dict:
         return {

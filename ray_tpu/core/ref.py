@@ -82,6 +82,58 @@ class ObjectRef:
         return get_core_worker().get_future(self)
 
 
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded ObjectRefs, in yield order
+    (reference: python/ray/_raylet.pyx:297 ObjectRefGenerator over
+    task_manager.cc's ObjectRefStream). Blocks in ``__next__`` until the
+    next item is reported by the executing worker; raises the task's error
+    if it failed; StopIteration once the generator completes."""
+
+    def __init__(self, task_id: bytes):
+        self._task_id = task_id
+        self._next = 0
+        self._released = False
+
+    @property
+    def task_id(self) -> bytes:
+        return self._task_id
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        ref = get_core_worker().next_stream_item(self._task_id, self._next)
+        if ref is None:
+            raise StopIteration
+        self._next += 1
+        return ref
+
+    async def __aiter__(self):
+        while True:
+            ref = await get_core_worker().next_stream_item_async(
+                self._task_id, self._next)
+            if ref is None:
+                return
+            self._next += 1
+            yield ref
+
+    def release(self) -> None:
+        """Drop interest in remaining items (unblocks the producer)."""
+        if not self._released:
+            self._released = True
+            if _core_worker is not None:
+                try:
+                    _core_worker.release_stream(self._task_id)
+                except Exception:
+                    pass
+
+    def __del__(self):
+        self.release()
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()[:12]}, next={self._next})"
+
+
 def _reconstruct_actor_handle(state: dict) -> "ActorHandle":
     h = ActorHandle(ActorID(state["actor_id"]), state["name"],
                     state["method_names"], state["max_task_retries"])

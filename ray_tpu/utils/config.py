@@ -76,6 +76,9 @@ _flag("worker_lease_pipeline_depth", int, 16, "Task pushes kept in flight per le
 _flag("worker_pool_max_idle_workers", int, 8, "Idle workers kept warm per node.")
 _flag("worker_pool_idle_ttl_s", int, 300, "Kill idle workers after this long.")
 
+# --- streaming generators ---
+_flag("streaming_generator_backpressure_items", int, 16, "Yielded-but-unconsumed items before the producer stalls (reference: generator_waiter.cc backpressure).")
+
 # --- fault tolerance ---
 _flag("max_task_retries_default", int, 3, "Default retries for retriable tasks.")
 _flag("actor_max_restarts_default", int, 0, "Default actor restarts.")
