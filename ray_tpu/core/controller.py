@@ -27,8 +27,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core.common import (ActorState, Address, NodeState, PGState,
                                  resources_add, resources_fit, resources_sub)
 from ray_tpu.core.pubsub import PubsubHub
-from ray_tpu.core.rpc import RpcClient, RpcServer
+from ray_tpu.core.rpc import RpcClient, RpcServer, long_poll
 from ray_tpu.utils import get_logger
+from ray_tpu.utils.aio import spawn
 from ray_tpu.utils.config import GlobalConfig
 
 logger = get_logger("controller")
@@ -98,6 +99,7 @@ class Controller:
     # ------------------------------------------------------------------
     # pubsub
     # ------------------------------------------------------------------
+    @long_poll
     async def pubsub_poll(self, channel: str, from_seq: int,
                           timeout: float = 30.0) -> dict:
         return await self.pubsub.poll(channel, from_seq, min(timeout, 60.0))
@@ -151,7 +153,7 @@ class Controller:
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (
                     ActorState.ALIVE, ActorState.PENDING):
-                asyncio.ensure_future(self._handle_actor_failure(
+                spawn(self._handle_actor_failure(
                     actor, f"node died: {reason}"))
         # Remaining agents learn via their node_events subscription
         # (object copies on that node are gone).
@@ -241,7 +243,7 @@ class Controller:
                            tuple(placement) if placement else None,
                            runtime_env)
         self.actors[actor_id] = entry
-        asyncio.ensure_future(self._schedule_actor(entry))
+        spawn(self._schedule_actor(entry))
         return {"actor_id": actor_id}
 
     async def _schedule_actor(self, entry: ActorEntry) -> None:
@@ -335,6 +337,7 @@ class Controller:
         return {"state": e.state, "addr": e.addr, "node_id": e.node_id,
                 "death_reason": e.death_reason, "name": e.name}
 
+    @long_poll
     async def wait_actor_ready(self, actor_id: bytes,
                                timeout: float = 120.0) -> dict:
         e = self.actors.get(actor_id)
@@ -373,7 +376,7 @@ class Controller:
                                      strategy: str) -> dict:
         pg = PGEntry(pg_id, bundles, strategy)
         self.pgs[pg_id] = pg
-        asyncio.ensure_future(self._schedule_pg(pg))
+        spawn(self._schedule_pg(pg))
         return {"pg_id": pg_id}
 
     def _plan_pg(self, pg: PGEntry) -> Optional[List[NodeEntry]]:
@@ -450,6 +453,7 @@ class Controller:
         pg.state = PGState.REMOVED
         pg.event.set()
 
+    @long_poll
     async def wait_pg_ready(self, pg_id: bytes, timeout: float = 60.0) -> str:
         pg = self.pgs.get(pg_id)
         if pg is None:
@@ -532,7 +536,7 @@ class Controller:
         server.register_object(self)
         port = await server.start_tcp(host, port)
         self._server = server
-        self._health_task = asyncio.ensure_future(self._health_loop())
+        self._health_task = spawn(self._health_loop())
         logger.info("controller listening on %s:%d", host, port)
         return port
 

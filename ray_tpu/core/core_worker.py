@@ -41,8 +41,9 @@ from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import MappedObject
 from ray_tpu.core.ref import ActorHandle, ObjectRef, set_core_worker
 from ray_tpu.core.rpc import (RpcApplicationError, RpcClient,
-                              RpcConnectionLost, RpcServer)
+                              RpcConnectionLost, RpcServer, long_poll)
 from ray_tpu.utils import get_logger
+from ray_tpu.utils.aio import spawn
 from ray_tpu.utils.config import GlobalConfig
 
 logger = get_logger("core_worker")
@@ -166,6 +167,12 @@ class CoreWorker:
     def _run(self, coro) -> concurrent.futures.Future:
         return asyncio.run_coroutine_threadsafe(coro, self._loop)
 
+    def _spawn(self, coro) -> None:
+        """Fire-and-forget a coroutine on the io loop with a STRONG
+        reference (see utils/aio.py: weakly-referenced tasks can be GC'd
+        mid-flight, killing the coroutine with GeneratorExit)."""
+        self._loop.call_soon_threadsafe(spawn, coro)
+
     async def _async_init(self) -> None:
         self.agent = RpcClient(self.agent_addr)
         self.controller = RpcClient(self.controller_addr)
@@ -184,10 +191,15 @@ class CoreWorker:
         return ("127.0.0.1", self.port)
 
     def _client_for_worker(self, addr: Address) -> RpcClient:
+        """Client to a peer worker/agent. Retries are safe: every retried
+        request carries a stable request id and the server replays the
+        cached first response instead of re-executing (rpc.py dedup), so
+        borrow accounting, stream reports, and task pushes stay
+        exactly-once per server process."""
         addr = tuple(addr)
         c = self._worker_clients.get(addr)
         if c is None:
-            c = RpcClient(addr, max_retries=0)
+            c = RpcClient(addr, max_retries=3)
             self._worker_clients[addr] = c
         return c
 
@@ -254,9 +266,9 @@ class CoreWorker:
             owner = ref.owner_addr
             try:
                 if owner is None or tuple(owner) == self.address:
-                    self._run(self._on_owned_ref_dropped(k))
+                    self._spawn(self._on_owned_ref_dropped(k))
                 else:
-                    self._run(self._notify_remove_borrow(tuple(owner), k))
+                    self._spawn(self._notify_remove_borrow(tuple(owner), k))
             except RuntimeError:
                 pass  # interpreter/loop shutdown
         else:
@@ -269,7 +281,7 @@ class CoreWorker:
         owner = ref.owner_addr
         if first and owner is not None and tuple(owner) != self.address:
             try:
-                self._run(self._notify_add_borrow(tuple(owner), k))
+                self._spawn(self._notify_add_borrow(tuple(owner), k))
             except RuntimeError:
                 pass
 
@@ -336,6 +348,7 @@ class CoreWorker:
                            size: int) -> None:
         self._mark_ready_stored(oid, node_id, tuple(addr), size)
 
+    @long_poll
     async def get_object_status(self, oid: bytes,
                                 timeout: float = 60.0) -> dict:
         try:
@@ -359,6 +372,7 @@ class CoreWorker:
     # streaming generators (owner side; reference: task_manager.cc
     # HandleReportGeneratorItemReturns + ObjectRefStream)
     # ------------------------------------------------------------------
+    @long_poll
     async def report_streamed_return(self, task_id: bytes, index: int,
                                      kind: str, data, meta, node_id,
                                      addr, size: int) -> dict:
@@ -718,7 +732,7 @@ class CoreWorker:
         if streaming:
             from ray_tpu.core.ref import ObjectRefGenerator
             self._streams[task_id.binary()] = _StreamState()
-            self._run(self._submit_and_track(spec))
+            self._spawn(self._submit_and_track(spec))
             return ObjectRefGenerator(task_id.binary())
         refs = []
         for i in range(num_returns):
@@ -726,9 +740,10 @@ class CoreWorker:
             ref = ObjectRef(oid, self.address)
             self.add_local_ref(ref)
             e = self._entry(oid.binary(), create=True)
-            e.creating_task = spec
+            if GlobalConfig.lineage_pinning_enabled:
+                e.creating_task = spec  # lineage for reconstruction
             refs.append(ref)
-        self._run(self._submit_and_track(spec))
+        self._spawn(self._submit_and_track(spec))
         return refs
 
     async def _submit_and_track(self, spec: TaskSpec) -> None:
@@ -901,7 +916,7 @@ class CoreWorker:
         finally:
             agent = self.agent if tuple(lease_node) == tuple(self.agent_addr) \
                 else self._client_for_worker(tuple(lease_node))
-            asyncio.ensure_future(self._return_lease_quiet(
+            spawn(self._return_lease_quiet(
                 agent, lease["lease_id"]))
 
     async def _push_one(self, client: RpcClient, spec: TaskSpec,
@@ -1075,7 +1090,7 @@ class CoreWorker:
         if streaming:
             from ray_tpu.core.ref import ObjectRefGenerator
             self._streams[task_id.binary()] = _StreamState()
-            self._run(self._submit_actor_and_track(spec))
+            self._spawn(self._submit_actor_and_track(spec))
             return ObjectRefGenerator(task_id.binary())
         refs = []
         for i in range(num_returns):
@@ -1084,7 +1099,7 @@ class CoreWorker:
             self.add_local_ref(ref)
             self._entry(oid.binary(), create=True)
             refs.append(ref)
-        self._run(self._submit_actor_and_track(spec))
+        self._spawn(self._submit_actor_and_track(spec))
         return refs[0] if num_returns == 1 else refs
 
     async def _submit_actor_and_track(self, spec: TaskSpec) -> None:
@@ -1126,7 +1141,7 @@ class CoreWorker:
 
         self._actor_sub = Subscription(self.controller, "actor_events",
                                        on_event)
-        self._run(self._start_actor_sub())
+        self._spawn(self._start_actor_sub())
 
     async def _start_actor_sub(self) -> None:
         if self._actor_sub is not None:
@@ -1154,7 +1169,11 @@ class CoreWorker:
             # caller again (its ordering state died with the old process).
             self._actor_seq_out[actor_id] = 0
             self._actor_incarnation[actor_id] = incarnation
-        client = RpcClient(addr, max_retries=0)
+        # Transport-level retries are exactly-once (request-id dedup on the
+        # server), so a lost reply or injected failure re-sends the SAME
+        # seqno instead of burning a new one — a fresh seqno for a push the
+        # worker never saw would park its ordering queue forever.
+        client = RpcClient(addr, max_retries=3)
         self._actor_clients[actor_id] = (addr, client, incarnation)
         return client
 
@@ -1193,6 +1212,7 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # task execution (worker side)
     # ------------------------------------------------------------------
+    @long_poll
     async def create_actor_local(self, spec_blob: bytes) -> None:
         creation = cloudpickle.loads(spec_blob)
         cls = cloudpickle.loads(creation["cls_blob"])
@@ -1224,6 +1244,7 @@ class CoreWorker:
             return True  # interrupted the running task
         return False  # queued/unknown: the exec-entry flag check handles it
 
+    @long_poll
     async def push_task(self, spec_blob: bytes) -> dict:
         spec: TaskSpec = cloudpickle.loads(spec_blob)
         if spec.is_actor_task:

@@ -48,10 +48,20 @@ def make_session_dir() -> str:
     return base
 
 
+def _child_env() -> Dict[str, str]:
+    """Propagate config overrides to spawned processes as RAY_TPU_* env
+    vars (the reference's GCS serializes --config-list to every process,
+    reference: python/ray/_private/services.py)."""
+    from ray_tpu.utils.config import Config, GlobalConfig
+    env = dict(os.environ)
+    env.update(Config.deserialize_into_env(GlobalConfig.serialize()))
+    return env
+
+
 def start_controller(session_dir: str) -> Tuple[subprocess.Popen, int]:
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu.core.controller", "--port", "0"],
-        stdout=subprocess.PIPE, cwd=os.getcwd())
+        stdout=subprocess.PIPE, cwd=os.getcwd(), env=_child_env())
     port = _wait_port_line(proc, "CONTROLLER_PORT=")
     return proc, port
 
@@ -66,7 +76,7 @@ def start_agent(controller_addr: Tuple[str, int], session_dir: str,
          "--resources", json.dumps(resources or {}),
          "--labels", json.dumps(labels or {}),
          "--session-dir", session_dir],
-        stdout=subprocess.PIPE, cwd=os.getcwd())
+        stdout=subprocess.PIPE, cwd=os.getcwd(), env=_child_env())
     port = _wait_port_line(proc, "AGENT_PORT=")
     return proc, port
 

@@ -34,8 +34,9 @@ from ray_tpu.core.common import (Address, resources_add, resources_fit,
 from ray_tpu.core.ids import NodeID, ObjectID
 from ray_tpu.core.object_store import LocalObjectStore
 from ray_tpu.core.pubsub import Subscription
-from ray_tpu.core.rpc import RpcClient, RpcServer
+from ray_tpu.core.rpc import RpcClient, RpcServer, long_poll
 from ray_tpu.utils import get_logger
+from ray_tpu.utils.aio import spawn
 from ray_tpu.utils.config import GlobalConfig
 
 logger = get_logger("node_agent")
@@ -69,6 +70,7 @@ class WorkerProc:
         self.ready = asyncio.Event()
         self.dedicated_actor: Optional[bytes] = None
         self.current_lease: Optional[bytes] = None
+        self.idle_since: float = 0.0
 
 
 class NodeAgent:
@@ -110,6 +112,20 @@ class NodeAgent:
             store_dir, GlobalConfig.object_store_memory_bytes)
         self._seal_waiters: Dict[bytes, asyncio.Event] = {}
         self._pulls: Dict[bytes, asyncio.Future] = {}
+        # Primary-copy ledger + spill state (reference:
+        # src/ray/raylet/local_object_manager.cc pins primaries and spills
+        # them to disk under memory pressure; restore on demand). Insertion
+        # order doubles as spill priority (oldest first).
+        self._primary: Dict[bytes, int] = {}         # oid -> total size
+        self._spilled: Dict[bytes, tuple] = {}       # oid -> (path, ds, ms)
+        self._spill_dir = (GlobalConfig.object_spill_dir
+                           or os.path.join(session_dir, "spill",
+                                           self.node_id.hex()[:12]))
+        self._spill_lock = asyncio.Lock()
+        self._restores: Dict[bytes, asyncio.Future] = {}
+        self.num_spilled = 0
+        self.bytes_spilled = 0
+        self.num_restored = 0
 
         self.workers: Dict[bytes, WorkerProc] = {}       # by worker_id
         self.idle_workers: List[WorkerProc] = []
@@ -135,8 +151,8 @@ class NodeAgent:
         await self.controller.call(
             "register_node", self.node_id.binary(), (self.host, self.port),
             self.resources_total, self.labels)
-        asyncio.ensure_future(self._heartbeat_loop())
-        asyncio.ensure_future(self._reap_loop())
+        spawn(self._heartbeat_loop())
+        spawn(self._reap_loop())
         # Cluster membership via controller pubsub (reference: raylets
         # subscribe to GCS node-info channel, not direct RPC pushes).
         self._node_sub = Subscription(
@@ -161,12 +177,22 @@ class NodeAgent:
             await asyncio.sleep(period)
 
     async def _reap_loop(self) -> None:
-        """Monitor child worker processes; clean up on death."""
+        """Monitor child worker processes; clean up on death; retire idle
+        workers past their TTL (reference: worker_pool.cc idle killing)."""
+        ttl = GlobalConfig.worker_pool_idle_ttl_s
         while not self._shutdown:
             await asyncio.sleep(0.1)
             for wid, w in list(self.workers.items()):
                 if w.proc.poll() is not None:
                     await self._on_worker_death(w)
+            now = time.monotonic()
+            for w in list(self.idle_workers):
+                if w.idle_since and now - w.idle_since > ttl:
+                    self.idle_workers.remove(w)
+                    try:
+                        w.proc.terminate()
+                    except Exception:
+                        pass
 
     async def _on_worker_death(self, w: WorkerProc) -> None:
         self.workers.pop(w.worker_id, None)
@@ -273,6 +299,7 @@ class NodeAgent:
     def _push_idle(self, w: WorkerProc) -> None:
         if w.proc.poll() is None and w.dedicated_actor is None:
             if len(self.idle_workers) < GlobalConfig.worker_pool_max_idle_workers:
+                w.idle_since = time.monotonic()
                 self.idle_workers.append(w)
             else:
                 w.proc.terminate()
@@ -281,6 +308,7 @@ class NodeAgent:
     # leases (reference: cluster_lease_manager.cc QueueAndScheduleLease +
     # spillback ScheduleOnNode)
     # ------------------------------------------------------------------
+    @long_poll
     async def request_lease(self, resources: dict, pg: Optional[bytes] = None,
                             bundle_index: int = -1, strategy=None,
                             _no_spill: bool = False) -> dict:
@@ -403,6 +431,7 @@ class NodeAgent:
     # ------------------------------------------------------------------
     # actors
     # ------------------------------------------------------------------
+    @long_poll
     async def start_actor(self, actor_id: bytes, spec_blob: bytes,
                           resources: dict, pg: Optional[bytes],
                           bundle_index: int,
@@ -475,18 +504,121 @@ class NodeAgent:
     # ------------------------------------------------------------------
     async def store_create(self, oid: bytes, data_size: int,
                            meta_size: int) -> str:
-        return self.store.create(ObjectID(oid), data_size, meta_size)
+        from ray_tpu.core.object_store import ObjectStoreFullError
+        if data_size + meta_size > self.store.capacity():
+            # Larger than the whole store: spilling can never help.
+            raise ObjectStoreFullError(
+                f"object of {data_size + meta_size} bytes exceeds store "
+                f"capacity {self.store.capacity()}")
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while True:
+            try:
+                return self.store.create(ObjectID(oid), data_size, meta_size)
+            except ObjectStoreFullError:
+                # Unpinned (secondary) copies were already LRU-evicted by
+                # the native store; make room by spilling pinned primaries
+                # to disk, then briefly queue the create while in-flight
+                # readers release space (reference:
+                # plasma/create_request_queue.cc backpressure).
+                await self._spill_for(data_size + meta_size)
+                try:
+                    return self.store.create(ObjectID(oid), data_size,
+                                             meta_size)
+                except ObjectStoreFullError:
+                    if asyncio.get_running_loop().time() >= deadline:
+                        raise
+                    await asyncio.sleep(0.1)
 
     async def store_seal(self, oid: bytes, owner_addr=None,
                          size: int = 0) -> None:
         o = ObjectID(oid)
         self.store.seal(o)
+        # Worker-created objects are PRIMARY copies on this node: pin them
+        # so LRU eviction can never drop the only copy of a live object
+        # (reference: local_object_manager.cc PinObjectsAndWaitForFree).
+        self.store.pin(o)
+        got = self.store.get(o)
+        if got is not None:
+            self._primary[oid] = got[1] + got[2]
+            self.store.release(o)
         ev = self._seal_waiters.pop(oid, None)
         if ev:
             ev.set()
         if owner_addr is not None:
-            asyncio.ensure_future(self._register_location(o, tuple(owner_addr),
+            spawn(self._register_location(o, tuple(owner_addr),
                                                           size))
+
+    # --- spilling (reference: local_object_manager.cc SpillObjects /
+    # restore; objects served straight from spill files for remote pulls
+    # like spilled_object_reader.cc) ------------------------------------
+    async def _spill_for(self, need_bytes: int) -> None:
+        async with self._spill_lock:
+            cap = self.store.capacity()
+            target = max(need_bytes,
+                         GlobalConfig.object_store_min_spill_bytes)
+            loop = asyncio.get_running_loop()
+            os.makedirs(self._spill_dir, exist_ok=True)
+            freed = 0
+            for oid in list(self._primary):
+                if self.store.used() + need_bytes <= cap and freed >= target:
+                    break
+                got = self.store.get(ObjectID(oid))
+                if got is None:
+                    self._primary.pop(oid, None)
+                    continue
+                path, ds, ms = got
+                spill_path = os.path.join(self._spill_dir,
+                                          ObjectID(oid).hex())
+                try:
+                    await loop.run_in_executor(
+                        None, shutil.copyfile, path, spill_path)
+                finally:
+                    self.store.release(ObjectID(oid))
+                self.store.delete(ObjectID(oid))
+                self._primary.pop(oid, None)
+                self._spilled[oid] = (spill_path, ds, ms)
+                self.num_spilled += 1
+                self.bytes_spilled += ds + ms
+                freed += ds + ms
+            logger.info("spilled %d bytes to %s (store used %d/%d)",
+                        freed, self._spill_dir, self.store.used(), cap)
+
+    async def _restore_spilled(self, oid: bytes) -> Optional[Tuple[str, int, int]]:
+        # Serialize concurrent restores per object (same pattern as
+        # pull_object): a second caller must not see the half-copied,
+        # unsealed object.
+        fut = self._restores.get(oid)
+        if fut is not None:
+            await asyncio.shield(fut)
+            return self.store.get(ObjectID(oid))
+        entry = self._spilled.get(oid)
+        if entry is None:
+            return None
+        fut = asyncio.get_running_loop().create_future()
+        self._restores[oid] = fut
+        try:
+            spill_path, ds, ms = entry
+            o = ObjectID(oid)
+            path = await self.store_create(oid, ds, ms)
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, shutil.copyfile, spill_path,
+                                       path)
+            self.store.seal(o)
+            self.store.pin(o)
+            self._primary[oid] = ds + ms
+            self._spilled.pop(oid, None)
+            try:
+                os.unlink(spill_path)
+            except OSError:
+                pass
+            self.num_restored += 1
+            fut.set_result(True)
+            return self.store.get(o)
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            self._restores.pop(oid, None)
 
     async def _register_location(self, oid: ObjectID, owner_addr: Address,
                                  size: int) -> None:
@@ -499,17 +631,25 @@ class NodeAgent:
             logger.debug("add_location failed for %s: %r", oid, e)
 
     async def store_get(self, oid: bytes) -> Optional[Tuple[str, int, int]]:
-        return self.store.get(ObjectID(oid))
+        got = self.store.get(ObjectID(oid))
+        if got is None and oid in self._spilled:
+            got = await self._restore_spilled(oid)
+        return got
 
     async def store_release(self, oid: bytes) -> None:
         self.store.release(ObjectID(oid))
 
     async def store_delete(self, oid: bytes) -> None:
         self.store.delete(ObjectID(oid))
+        self._drop_spilled(oid)
 
     async def store_contains(self, oid: bytes) -> int:
-        return self.store.contains(ObjectID(oid))
+        c = self.store.contains(ObjectID(oid))
+        if c == 0 and oid in self._spilled:
+            return 1  # spilled-but-local counts as present (restored on get)
+        return c
 
+    @long_poll
     async def wait_seal(self, oid: bytes, timeout: float = 1.0) -> bool:
         if self.store.contains(ObjectID(oid)) == 1:
             return True
@@ -532,6 +672,9 @@ class NodeAgent:
     async def object_info(self, oid: bytes) -> Optional[Tuple[int, int]]:
         got = self.store.get(ObjectID(oid))
         if got is None:
+            spilled = self._spilled.get(oid)
+            if spilled is not None:
+                return spilled[1], spilled[2]
             return None
         path, ds, ms = got
         self.store.release(ObjectID(oid))
@@ -540,7 +683,20 @@ class NodeAgent:
     async def fetch_chunk(self, oid: bytes, offset: int, length: int) -> bytes:
         got = self.store.get(ObjectID(oid))
         if got is None:
-            raise KeyError(f"object not local: {ObjectID(oid)}")
+            # Serve remote pulls straight from the spill file — no restore
+            # churn (reference: spilled_object_reader.cc). Spill files live
+            # on real disk: read off-loop.
+            spilled = self._spilled.get(oid)
+            if spilled is None:
+                raise KeyError(f"object not local: {ObjectID(oid)}")
+
+            def _read_spill(path=spilled[0]):
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(length)
+
+            return await asyncio.get_running_loop().run_in_executor(
+                None, _read_spill)
         path, ds, ms = got
         try:
             with open(path, "rb") as f:
@@ -549,6 +705,7 @@ class NodeAgent:
         finally:
             self.store.release(ObjectID(oid))
 
+    @long_poll
     async def pull_object(self, oid: bytes, from_addr) -> bool:
         """Fetch a remote object into the local store (idempotent)."""
         o = ObjectID(oid)
@@ -566,7 +723,9 @@ class NodeAgent:
                 raise KeyError("remote no longer has object")
             ds, ms = info
             total = ds + ms
-            path = self.store.create(o, ds, ms)
+            # Backpressured create: spills pinned primaries if the store is
+            # full of them (a plain store.create would fail forever).
+            path = await self.store_create(oid, ds, ms)
             chunk = GlobalConfig.object_transfer_chunk_bytes
             with open(path, "r+b") as f:
                 off = 0
@@ -598,6 +757,16 @@ class NodeAgent:
                 self.store.delete(ObjectID(oid))
             except Exception:
                 pass
+            self._primary.pop(oid, None)
+            self._drop_spilled(oid)
+
+    def _drop_spilled(self, oid: bytes) -> None:
+        entry = self._spilled.pop(oid, None)
+        if entry is not None:
+            try:
+                os.unlink(entry[0])
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------
     # notifications / state
@@ -625,6 +794,13 @@ class NodeAgent:
             "store_capacity": self.store.capacity(),
             "store_objects": self.store.num_objects(),
             "store_evictions": self.store.num_evictions(),
+            "store_pinned": len(self._primary),
+            "num_spilled": self.num_spilled,
+            "bytes_spilled": self.bytes_spilled,
+            "num_restored": self.num_restored,
+            "spilled_objects": len(self._spilled),
+            "event_stats": {m: tuple(v)
+                            for m, v in self._server.event_stats.items()},
         }
 
     async def ping(self) -> str:

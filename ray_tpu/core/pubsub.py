@@ -111,7 +111,8 @@ class Subscription:
         self.next_seq = -1 if from_latest else 0
 
     def start(self) -> "Subscription":
-        self._task = asyncio.ensure_future(self._run())
+        from ray_tpu.utils.aio import spawn as _spawn
+        self._task = _spawn(self._run())
         return self
 
     def stop(self) -> None:

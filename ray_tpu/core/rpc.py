@@ -36,6 +36,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 import msgpack
 
 from ray_tpu.utils import get_logger
+from ray_tpu.utils.aio import spawn
 from ray_tpu.utils.config import GlobalConfig
 
 logger = get_logger("rpc")
@@ -86,6 +87,13 @@ def _write_msg(writer: asyncio.StreamWriter, msg: Any) -> None:
 Handler = Callable[..., Awaitable[Any]]
 
 
+def long_poll(fn: Handler) -> Handler:
+    """Mark a handler as legitimately long-running (parks awaiting events):
+    exempt from the slow-handler warning of the instrumented loop."""
+    fn._rpc_long_poll = True  # type: ignore[attr-defined]
+    return fn
+
+
 class RpcServer:
     """Serves registered async handlers over TCP and/or a unix socket."""
 
@@ -102,9 +110,15 @@ class RpcServer:
         self.port: Optional[int] = None
         # request_id -> Future[(status, payload)] (in-flight or completed)
         self._dedup: "OrderedDict[str, asyncio.Future]" = OrderedDict()
+        # Per-handler event stats (reference: src/ray/common/asio/
+        # instrumented_io_context + event_stats.cc): count, total/max time.
+        self.event_stats: Dict[str, list] = {}  # method -> [n, total_s, max_s]
+        self._long_poll_methods: set = set()
 
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
+        if getattr(handler, "_rpc_long_poll", False):
+            self._long_poll_methods.add(method)
 
     def register_object(self, obj: Any, prefix: str = "") -> None:
         """Register every public async method of obj as `prefix.method`."""
@@ -144,13 +158,13 @@ class RpcServer:
                     return
                 seqno, method, payload = msg[0], msg[1], msg[2]
                 rid = msg[3] if len(msg) > 3 else None
-                asyncio.ensure_future(
-                    self._dispatch(seqno, method, payload, writer, rid))
+                spawn(self._dispatch(seqno, method, payload, writer, rid))
         finally:
             writer.close()
 
     async def _execute(self, method: str, payload: bytes) -> Tuple[int, bytes]:
         handler = self._handlers.get(method)
+        t0 = time.perf_counter() if GlobalConfig.event_stats_enabled else 0.0
         try:
             if handler is None:
                 raise RpcError(f"[{self._name}] no such method: {method}")
@@ -162,6 +176,20 @@ class RpcServer:
                 return 1, pickle.dumps(e, protocol=5)
             except Exception:
                 return 1, pickle.dumps(RpcError(repr(e)), protocol=5)
+        finally:
+            if t0:
+                dt = time.perf_counter() - t0
+                st = self.event_stats.get(method)
+                if st is None:
+                    st = self.event_stats[method] = [0, 0.0, 0.0]
+                st[0] += 1
+                st[1] += dt
+                st[2] = max(st[2], dt)
+                warn_s = GlobalConfig.handler_warning_timeout_ms / 1000
+                # @long_poll handlers legitimately park awaiting events.
+                if dt > warn_s and method not in self._long_poll_methods:
+                    logger.warning("[%s] handler %s took %.0fms",
+                                   self._name, method, dt * 1000)
 
     async def _dispatch(self, seqno: int, method: str, payload: bytes,
                         writer: asyncio.StreamWriter,
@@ -230,7 +258,7 @@ class RpcClient:
                 host, port = self._address
                 self._reader, self._writer = await asyncio.open_connection(
                     host, port)
-            self._recv_task = asyncio.ensure_future(self._recv_loop())
+            self._recv_task = spawn(self._recv_loop())
 
     async def _recv_loop(self) -> None:
         assert self._reader is not None
@@ -255,6 +283,12 @@ class RpcClient:
         if self._writer is not None:
             self._writer.close()
             self._writer = None
+        # Reap the recv loop of the dead connection — reconnects start a
+        # fresh one and an orphaned pending task would leak per reconnect.
+        if (self._recv_task is not None and not self._recv_task.done()
+                and self._recv_task is not asyncio.current_task()):
+            self._recv_task.cancel()
+            self._recv_task = None
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(exc)
