@@ -171,7 +171,13 @@ class CoreWorker:
         """Fire-and-forget a coroutine on the io loop with a STRONG
         reference (see utils/aio.py: weakly-referenced tasks can be GC'd
         mid-flight, killing the coroutine with GeneratorExit)."""
-        self._loop.call_soon_threadsafe(spawn, coro)
+        try:
+            if self._loop.is_closed():
+                coro.close()
+                return
+            self._loop.call_soon_threadsafe(spawn, coro)
+        except RuntimeError:  # loop shut down mid-call
+            coro.close()
 
     async def _async_init(self) -> None:
         self.agent = RpcClient(self.agent_addr)
@@ -1344,6 +1350,7 @@ class CoreWorker:
         returns = []
         for i, value in enumerate(results):
             sv = serialization.serialize(value)
+            await self._hold_reply_refs(sv.contained_refs)
             oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
             if sv.total_size <= GlobalConfig.max_direct_call_object_size:
                 returns.append(("inline", sv.to_bytes(), sv.meta()))
@@ -1352,6 +1359,37 @@ class CoreWorker:
                 returns.append(("stored", self.node_id, self.agent_addr,
                                 sv.total_size))
         return {"error": None, "returns": returns}
+
+    async def _hold_reply_refs(self, contained_refs) -> None:
+        """ObjectRefs FORWARDED inside a task result race their own
+        lifetime: once serialized, the worker's last Python reference can
+        die (freeing a self-owned object) before the receiver's borrow
+        registration lands. Take a proxy borrow for a grace window so the
+        handoff always survives (reference: reference_count.cc tracks
+        borrowers through nested task returns explicitly)."""
+        refs = list(contained_refs)
+        if not refs:
+            return
+        for r in refs:
+            if self._is_self_owned(r):
+                await self.add_borrow(r.binary())
+            else:
+                await self._notify_add_borrow(tuple(r.owner_addr),
+                                              r.binary())
+
+        async def _drop_after_grace():
+            await asyncio.sleep(120)
+            for r in refs:
+                try:
+                    if self._is_self_owned(r):
+                        await self.remove_borrow(r.binary())
+                    else:
+                        await self._notify_remove_borrow(
+                            tuple(r.owner_addr), r.binary())
+                except Exception:
+                    pass
+
+        spawn(_drop_after_grace())
 
     async def _execute_streaming(self, spec: TaskSpec, fn) -> dict:
         """Run a generator task: the exec thread pulls items from the user
@@ -1416,6 +1454,7 @@ class CoreWorker:
     async def _emit_stream_item(self, owner: RpcClient, spec: TaskSpec,
                                 index: int, sv) -> bool:
         """Report one yielded item to the owner; False = consumer gone."""
+        await self._hold_reply_refs(sv.contained_refs)
         if sv.total_size <= GlobalConfig.max_direct_call_object_size:
             reply = await owner.call(
                 "report_streamed_return", spec.task_id, index, "inline",

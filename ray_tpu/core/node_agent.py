@@ -681,29 +681,40 @@ class NodeAgent:
         return ds, ms
 
     async def fetch_chunk(self, oid: bytes, offset: int, length: int) -> bytes:
-        got = self.store.get(ObjectID(oid))
-        if got is None:
-            # Serve remote pulls straight from the spill file — no restore
-            # churn (reference: spilled_object_reader.cc). Spill files live
-            # on real disk: read off-loop.
-            spilled = self._spilled.get(oid)
-            if spilled is None:
-                raise KeyError(f"object not local: {ObjectID(oid)}")
+        for attempt in range(3):
+            got = self.store.get(ObjectID(oid))
+            if got is None:
+                # Serve remote pulls straight from the spill file — no
+                # restore churn (reference: spilled_object_reader.cc).
+                # Spill files live on real disk: read off-loop. A
+                # concurrent restore may unlink the file under us; retry
+                # re-resolves against the (now restored) store.
+                spilled = self._spilled.get(oid)
+                if spilled is None:
+                    restore_fut = self._restores.get(oid)
+                    if restore_fut is not None:
+                        await asyncio.shield(restore_fut)
+                        continue
+                    raise KeyError(f"object not local: {ObjectID(oid)}")
 
-            def _read_spill(path=spilled[0]):
+                def _read_spill(path=spilled[0]):
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        return f.read(length)
+
+                try:
+                    return await asyncio.get_running_loop().run_in_executor(
+                        None, _read_spill)
+                except FileNotFoundError:
+                    continue  # restored mid-read: serve from the store
+            path, ds, ms = got
+            try:
                 with open(path, "rb") as f:
                     f.seek(offset)
                     return f.read(length)
-
-            return await asyncio.get_running_loop().run_in_executor(
-                None, _read_spill)
-        path, ds, ms = got
-        try:
-            with open(path, "rb") as f:
-                f.seek(offset)
-                return f.read(length)
-        finally:
-            self.store.release(ObjectID(oid))
+            finally:
+                self.store.release(ObjectID(oid))
+        raise KeyError(f"object not local: {ObjectID(oid)}")
 
     @long_poll
     async def pull_object(self, oid: bytes, from_addr) -> bool:

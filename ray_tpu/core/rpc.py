@@ -97,11 +97,13 @@ def long_poll(fn: Handler) -> Handler:
 class RpcServer:
     """Serves registered async handlers over TCP and/or a unix socket."""
 
-    # Completed-response cache for retry dedup (per server process). Bodies
-    # above the byte cap are not cached (bulk reads like kv_get are
-    # idempotent; re-executing them on a rare lost reply beats pinning MBs).
+    # Completed-response cache for retry dedup (per server process).
+    # Exactly-once depends on entries STAYING cached (an evicted entry lets
+    # a retried mutating call re-execute), so eviction is by total byte
+    # budget + entry count, oldest first — large bodies stay cached, they
+    # just push the budget harder.
     _DEDUP_CAP = 4096
-    _DEDUP_MAX_BODY = 256 * 1024
+    _DEDUP_MAX_BYTES = 128 * 1024 * 1024
 
     def __init__(self, name: str = "server"):
         self._name = name
@@ -110,6 +112,7 @@ class RpcServer:
         self.port: Optional[int] = None
         # request_id -> Future[(status, payload)] (in-flight or completed)
         self._dedup: "OrderedDict[str, asyncio.Future]" = OrderedDict()
+        self._dedup_bytes = 0
         # Per-handler event stats (reference: src/ray/common/asio/
         # instrumented_io_context + event_stats.cc): count, total/max time.
         self.event_stats: Dict[str, list] = {}  # method -> [n, total_s, max_s]
@@ -209,13 +212,20 @@ class RpcServer:
             else:
                 fut = asyncio.get_running_loop().create_future()
                 self._dedup[rid] = fut
-                while len(self._dedup) > self._DEDUP_CAP:
-                    self._dedup.popitem(last=False)
                 status, body = await self._execute(method, payload)
                 if not fut.done():
                     fut.set_result((status, body))
-                if len(body) > self._DEDUP_MAX_BODY:
-                    self._dedup.pop(rid, None)
+                self._dedup_bytes += len(body)
+                while (len(self._dedup) > self._DEDUP_CAP
+                       or self._dedup_bytes > self._DEDUP_MAX_BYTES):
+                    old_rid, old_fut = self._dedup.popitem(last=False)
+                    if old_fut.done():
+                        try:
+                            self._dedup_bytes -= len(old_fut.result()[1])
+                        except Exception:
+                            pass
+                    if not self._dedup:
+                        break
         try:
             _write_msg(writer, [seqno, status, body])
             await writer.drain()

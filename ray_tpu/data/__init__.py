@@ -1,0 +1,19 @@
+"""ray_tpu.data — streaming datasets for TPU ingest.
+
+Analogue of Ray Data (reference: python/ray/data/__init__.py public
+surface), rebuilt linear + TPU-first: blocks stream through generator
+tasks; batches land as ``jax.Array`` via the zero-copy host path
+(SURVEY north star: Arrow -> DLPack -> jax.Array).
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
+from ray_tpu.data.dataset import (DataIterator, Dataset, from_blocks,
+                                  from_items, from_numpy, range,  # noqa: A004
+                                  read_csv, read_json, read_parquet)
+
+__all__ = [
+    "Block", "BlockAccessor", "concat_blocks",
+    "Dataset", "DataIterator",
+    "range", "from_items", "from_numpy", "from_blocks",
+    "read_parquet", "read_csv", "read_json",
+]

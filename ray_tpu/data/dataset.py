@@ -1,0 +1,302 @@
+"""Dataset — the lazy distributed data pipeline.
+
+Analogue of the reference's Dataset (reference: python/ray/data/dataset.py —
+map:276, map_batches:457, streaming_split:1826, iter_batches:4973,
+iter_torch_batches:5044 → here iter_jax_batches). Redesigned linear:
+a Dataset is (sources, fused stage chain); every transform appends a
+block→blocks stage; execution streams blocks through one generator task per
+source (executor.py). There is no separate logical/physical optimizer pass
+because the representation IS the fused physical plan — the reference's
+fusion rule output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_py_range = range  # the public range() below shadows the builtin
+from ray_tpu.data import datasource as _ds
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
+from ray_tpu.data.executor import apply_stages, execute_streaming
+from ray_tpu.data.iterator import (iter_batches_from_refs,
+                                   iter_jax_batches_from_refs)
+
+
+class Dataset:
+    def __init__(self, sources: List[Any], stages: Optional[List] = None,
+                 name: str = "dataset"):
+        self._sources = sources  # ObjectRefs or read callables
+        self._stages = list(stages or [])
+        self._name = name
+
+    # ------------------------------------------------------------------
+    # transforms (lazy; each appends a block -> Iterator[block] stage)
+    # ------------------------------------------------------------------
+    def _with_stage(self, stage, name: str) -> "Dataset":
+        return Dataset(self._sources, self._stages + [stage],
+                       f"{self._name}->{name}")
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    fn_kwargs: Optional[dict] = None) -> "Dataset":
+        """Apply fn to batches (reference: dataset.py:457). With
+        batch_size=None each block is one batch; otherwise blocks are
+        re-chunked to batch_size rows (within a block; a trailing short
+        batch per block is possible, as with the reference's default
+        shuffle=False zero-copy path)."""
+        kwargs = fn_kwargs or {}
+
+        def stage(block):
+            from ray_tpu.data.iterator import _format_batch
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            step = batch_size or n or 1
+            for lo in _py_range(0, n, step):
+                batch = acc.slice(lo, min(n, lo + step))
+                out = fn(_format_batch(batch, batch_format), **kwargs)
+                yield out
+
+        return self._with_stage(stage, "map_batches")
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        def stage(block):
+            yield [fn(row) for row in BlockAccessor(block).to_rows()]
+
+        return self._with_stage(stage, "map")
+
+    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
+        def stage(block):
+            out: List[Any] = []
+            for row in BlockAccessor(block).to_rows():
+                out.extend(fn(row))
+            yield out
+
+        return self._with_stage(stage, "flat_map")
+
+    def filter(self, pred: Callable[[Any], bool]) -> "Dataset":
+        def stage(block):
+            acc = BlockAccessor(block)
+            if isinstance(block, dict):  # columnar fast path
+                rows = acc.to_rows()
+                keep = [r for r in rows if pred(r)]
+                if keep:
+                    yield {k: np.asarray([r[k] for r in keep])
+                           for k in keep[0]}
+                return
+            keep = [r for r in acc.to_rows() if pred(r)]
+            if keep:
+                yield keep
+
+        return self._with_stage(stage, "filter")
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def iter_block_refs(self, window: int = 2) -> Iterator[Any]:
+        return execute_streaming(self._sources, self._stages, window=window)
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result holds block refs (reference:
+        dataset.py materialize -> MaterializedDataset)."""
+        refs = list(self.iter_block_refs())
+        return Dataset(refs, [], name=f"{self._name}(materialized)")
+
+    def iter_batches(self, *, batch_size: Optional[int] = None,
+                     batch_format: str = "numpy", prefetch_blocks: int = 2,
+                     drop_last: bool = False) -> Iterator[Any]:
+        return iter_batches_from_refs(
+            self.iter_block_refs(), batch_size=batch_size,
+            batch_format=batch_format, prefetch_blocks=prefetch_blocks,
+            drop_last=drop_last)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for batch in self.iter_batches(batch_format="rows"):
+            yield from batch
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = None,
+                         sharding: Optional[Any] = None,
+                         global_batch: bool = False,
+                         prefetch_blocks: int = 2,
+                         drop_last: bool = True) -> Iterator[Dict[str, Any]]:
+        """Batches as jax.Arrays — the north-star ingest hop (host path is
+        zero-copy out of the shm store; device transfer is the only copy)."""
+        return iter_jax_batches_from_refs(
+            self.iter_block_refs(), batch_size=batch_size,
+            sharding=sharding, global_batch=global_batch,
+            prefetch_blocks=prefetch_blocks, drop_last=drop_last)
+
+    # ------------------------------------------------------------------
+    # consumption helpers
+    # ------------------------------------------------------------------
+    def take(self, k: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for batch in self.iter_batches(batch_format="rows"):
+            out.extend(batch)
+            if len(out) >= k:
+                return out[:k]
+        return out
+
+    def take_all(self) -> List[Any]:
+        out: List[Any] = []
+        for batch in self.iter_batches(batch_format="rows"):
+            out.extend(batch)
+        return out
+
+    def count(self) -> int:
+        return sum(BlockAccessor(ray_tpu.get(r)).num_rows()
+                   for r in self.iter_block_refs())
+
+    def schema(self) -> Any:
+        for ref in self.iter_block_refs(window=1):
+            return BlockAccessor(ray_tpu.get(ref)).schema()
+        return None
+
+    def num_blocks(self) -> int:
+        return len(self._sources)
+
+    # ------------------------------------------------------------------
+    # reorganization
+    # ------------------------------------------------------------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Materialize then rebalance rows into num_blocks blocks."""
+        mat = self.materialize()
+
+        @ray_tpu.remote(num_returns="streaming")
+        def _rechunk(refs, n):
+            blocks = [ray_tpu.get(r) for r in refs]
+            whole = concat_blocks(blocks)
+            acc = BlockAccessor(whole)
+            total = acc.num_rows()
+            per = (total + n - 1) // n
+            for lo in _py_range(0, total, per):
+                yield acc.slice(lo, min(total, lo + per))
+
+        refs = [r for r in _rechunk.remote(mat._sources, num_blocks)]
+        return Dataset(refs, [], name=f"{self._name}(repartition)")
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Global shuffle: materialize + permute (single-task; fine at the
+        block counts this framework targets per host — the reference's
+        distributed shuffle service is multi-TB scale)."""
+        n_blocks = max(1, len(self._sources))
+        mat = self.materialize()
+
+        @ray_tpu.remote(num_returns="streaming")
+        def _shuffle(refs, n, seed):
+            rng = np.random.RandomState(seed)
+            whole = concat_blocks([ray_tpu.get(r) for r in refs])
+            acc = BlockAccessor(whole)
+            total = acc.num_rows()
+            perm = rng.permutation(total)
+            if isinstance(whole, dict):
+                shuffled: Block = {k: v[perm] for k, v in whole.items()}
+            else:
+                rows = acc.to_rows()
+                shuffled = [rows[i] for i in perm]
+            sacc = BlockAccessor(shuffled)
+            per = (total + n - 1) // n
+            for lo in _py_range(0, total, per):
+                yield sacc.slice(lo, min(total, lo + per))
+
+        refs = [r for r in _shuffle.remote(mat._sources, n_blocks, seed)]
+        return Dataset(refs, [], name=f"{self._name}(shuffled)")
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Materialize and split into n datasets by whole blocks
+        (reference: dataset.py split)."""
+        mat = self.materialize()
+        refs = mat._sources
+        shards: List[List[Any]] = [[] for _ in _py_range(n)]
+        for i, r in enumerate(refs):
+            shards[i % n].append(r)
+        return [Dataset(s, [], name=f"{self._name}(split{i})")
+                for i, s in enumerate(shards)]
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        """n per-consumer iterators over one shared streaming execution
+        (reference: dataset.py:1826 streaming_split + output_splitter
+        coordinated by a SplitCoordinator actor)."""
+        from ray_tpu.data.split import create_streaming_split
+        return create_streaming_split(self, n, equal=equal)
+
+    def __repr__(self):
+        return (f"Dataset(name={self._name!r}, "
+                f"blocks={len(self._sources)}, stages={len(self._stages)})")
+
+
+class DataIterator:
+    """Per-consumer iterator facade (reference: data/iterator.py:71).
+
+    Wraps a block-ref iterable factory so iter_batches can be called
+    multiple times where the underlying source allows it."""
+
+    def __init__(self, ref_iter_factory: Callable[[], Iterator[Any]],
+                 name: str = "iter"):
+        self._factory = ref_iter_factory
+        self._name = name
+
+    def iter_block_refs(self) -> Iterator[Any]:
+        return self._factory()
+
+    def iter_batches(self, *, batch_size: Optional[int] = None,
+                     batch_format: str = "numpy", prefetch_blocks: int = 2,
+                     drop_last: bool = False) -> Iterator[Any]:
+        return iter_batches_from_refs(
+            self._factory(), batch_size=batch_size,
+            batch_format=batch_format, prefetch_blocks=prefetch_blocks,
+            drop_last=drop_last)
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = None,
+                         sharding: Optional[Any] = None,
+                         global_batch: bool = False,
+                         prefetch_blocks: int = 2,
+                         drop_last: bool = True) -> Iterator[Dict[str, Any]]:
+        return iter_jax_batches_from_refs(
+            self._factory(), batch_size=batch_size, sharding=sharding,
+            global_batch=global_batch, prefetch_blocks=prefetch_blocks,
+            drop_last=drop_last)
+
+    def __repr__(self):
+        return f"DataIterator({self._name})"
+
+
+# ---------------------------------------------------------------------------
+# constructors (reference: ray.data.range / from_items / read_*)
+# ---------------------------------------------------------------------------
+
+def range(n: int, *, num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    return Dataset(_ds.range_read_tasks(n, num_blocks), name=f"range({n})")
+
+
+def from_items(items: List[Any], *, num_blocks: int = 1) -> Dataset:
+    return Dataset(_ds.items_read_tasks(list(items), num_blocks),
+                   name="from_items")
+
+
+def from_numpy(batch, *, num_blocks: int = 1) -> Dataset:
+    if isinstance(batch, np.ndarray):
+        batch = {"data": batch}
+    return Dataset(_ds.numpy_read_tasks(batch, num_blocks),
+                   name="from_numpy")
+
+
+def from_blocks(blocks: List[Block]) -> Dataset:
+    return Dataset([ray_tpu.put(b) for b in blocks], name="from_blocks")
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    return Dataset(_ds.parquet_read_tasks(paths, columns),
+                   name="read_parquet")
+
+
+def read_csv(paths) -> Dataset:
+    return Dataset(_ds.csv_read_tasks(paths), name="read_csv")
+
+
+def read_json(paths) -> Dataset:
+    return Dataset(_ds.json_read_tasks(paths), name="read_json")

@@ -1,0 +1,130 @@
+"""Read tasks — lazy per-source block producers.
+
+Analogue of the reference's datasource layer (reference:
+python/ray/data/_internal/datasource/ — parquet/csv/json/range readers
+produce ReadTasks; python/ray/data/datasource/datasource.py ReadTask).
+Each read task is a zero-arg callable yielding blocks, executed inside one
+streaming source task by the executor; file formats ride pyarrow.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+DEFAULT_ROWS_PER_BLOCK = 64 * 1024
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if not f.startswith("."))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+def range_read_tasks(n: int, num_blocks: Optional[int] = None
+                     ) -> List[Callable[[], Iterator[Any]]]:
+    num_blocks = num_blocks or max(1, min(16, n // DEFAULT_ROWS_PER_BLOCK
+                                          or 1))
+    per = (n + num_blocks - 1) // num_blocks
+    tasks = []
+    for b in range(num_blocks):
+        lo, hi = b * per, min(n, (b + 1) * per)
+        if lo >= hi:
+            break
+
+        def read(lo=lo, hi=hi):
+            yield {"id": np.arange(lo, hi, dtype=np.int64)}
+
+        tasks.append(read)
+    return tasks
+
+
+def items_read_tasks(items: List[Any], num_blocks: int = 1):
+    num_blocks = max(1, min(num_blocks, len(items) or 1))
+    per = (len(items) + num_blocks - 1) // num_blocks
+    tasks = []
+    for b in range(num_blocks):
+        chunk = items[b * per:(b + 1) * per]
+        if not chunk:
+            break
+
+        def read(chunk=chunk):
+            yield list(chunk)
+
+        tasks.append(read)
+    return tasks
+
+
+def numpy_read_tasks(batch: Dict[str, np.ndarray],
+                     num_blocks: int = 1):
+    n = len(next(iter(batch.values())))
+    num_blocks = max(1, min(num_blocks, n))
+    per = (n + num_blocks - 1) // num_blocks
+    tasks = []
+    for b in range(num_blocks):
+        lo, hi = b * per, min(n, (b + 1) * per)
+        if lo >= hi:
+            break
+        chunk = {k: v[lo:hi] for k, v in batch.items()}
+
+        def read(chunk=chunk):
+            yield chunk
+
+        tasks.append(read)
+    return tasks
+
+
+def parquet_read_tasks(paths, columns: Optional[List[str]] = None):
+    """One read task per file; row groups stream as separate blocks
+    (reference: _internal/datasource/parquet_datasource.py splits by row
+    group for memory-bounded streaming)."""
+    files = _expand_paths(paths)
+    tasks = []
+    for path in files:
+        def read(path=path, columns=columns):
+            import pyarrow.parquet as pq
+            f = pq.ParquetFile(path)
+            for rg in range(f.num_row_groups):
+                yield f.read_row_group(rg, columns=columns)
+
+        tasks.append(read)
+    return tasks
+
+
+def csv_read_tasks(paths, **read_options):
+    files = _expand_paths(paths)
+    tasks = []
+    for path in files:
+        def read(path=path):
+            import pyarrow.csv as pacsv
+            yield pacsv.read_csv(path)
+
+        tasks.append(read)
+    return tasks
+
+
+def json_read_tasks(paths):
+    files = _expand_paths(paths)
+    tasks = []
+    for path in files:
+        def read(path=path):
+            import pyarrow.json as pajson
+            yield pajson.read_json(path)
+
+        tasks.append(read)
+    return tasks

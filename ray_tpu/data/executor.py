@@ -1,75 +1,117 @@
-"""Streaming executor: drives fused per-block pipelines through the task
-runtime with bounded in-flight work.
+"""Streaming executor: drives per-source block pipelines through the task
+runtime as STREAMING GENERATOR tasks with bounded in-flight work.
 
 Analogue of the reference's streaming execution (reference:
 python/ray/data/_internal/execution/streaming_executor.py:61 executor loop,
 streaming_executor_state.py select_operator_to_run/process_completed_tasks,
+operators/map_operator.py tasks returning ObjectRefGenerators of blocks,
 logical/optimizers.py operator fusion). Redesigned for the linear plans this
-framework supports: consecutive map-like stages FUSE into one remote task
-per block (the reference's MapOperator fusion rule), and the executor is a
-pull-based generator — blocks are submitted as a sliding window
-(backpressure = window size) and yielded in order as they complete, so
-downstream consumption (e.g. feeding a TPU train step) overlaps with
-upstream task execution.
+framework supports:
+
+  * ALL map-like stages FUSE into the read/source task — one streaming
+    remote task per source yields transformed blocks as they are produced
+    (the reference's MapOperator fusion rule taken to its limit).
+  * Backpressure is the generator backpressure built into the runtime: a
+    producer task stalls once `streaming_generator_backpressure_items`
+    yielded blocks sit unconsumed, so the executor needs no resource
+    manager of its own for the linear case.
+  * The executor keeps `window` source tasks active and yields block refs
+    in source order — downstream consumption (a TPU train step) overlaps
+    with upstream reads and transforms.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterator, List, Optional
+from typing import Any, Callable, Iterator, List, Optional, Tuple
 
 import ray_tpu
 from ray_tpu.utils import get_logger
 
 logger = get_logger("data.executor")
 
-# In-flight block-task window (reference analogue: resource_manager.py
-# ReservationOpResourceAllocator, collapsed to a static window).
-DEFAULT_WINDOW = 8
+# Number of source tasks kept in flight (reference analogue:
+# resource_manager.py ReservationOpResourceAllocator, collapsed to a window;
+# per-task block backpressure bounds memory within each).
+DEFAULT_WINDOW = 2
+
+# A stage maps one block to zero or more output blocks.
+Stage = Callable[[Any], Iterator[Any]]
 
 
-def _apply_stages(block, stages):
+def apply_stages(block: Any, stages: List[Stage]) -> Iterator[Any]:
     """Run the fused stage chain over one block (executes inside a task)."""
-    for fn in stages:
-        block = fn(block)
-    return block
+    if not stages:
+        yield block
+        return
+    head, rest = stages[0], stages[1:]
+    for out in head(block):
+        yield from apply_stages(out, rest)
 
 
-def execute_streaming(input_refs: List[Any], stages: List[Callable],
+def _source_task_fn(source, stages_blob: bytes):
+    """Body of one fused streaming source task: read -> stages -> yield.
+
+    `source` arrives as either a pickled read callable (bytes) or the
+    BLOCK VALUE itself: a materialized ObjectRef source is passed as a real
+    task arg (so borrow accounting pins it) and the runtime resolves ref
+    args to values before execution.
+    """
+    import cloudpickle as cp
+
+    stages = cp.loads(stages_blob)
+    if isinstance(source, (bytes, bytearray)):
+        blocks: Iterator[Any] = cp.loads(source)()  # read callable
+    else:
+        blocks = iter([source])  # already-resolved materialized block
+    for block in blocks:
+        yield from apply_stages(block, stages)
+
+
+def execute_streaming(sources: List[Any], stages: List[Stage],
                       window: int = DEFAULT_WINDOW,
                       resources: Optional[dict] = None) -> Iterator[Any]:
-    """Yield output block refs in input order, keeping at most `window`
-    fused-block tasks in flight."""
-    if not stages:
-        yield from input_refs
+    """Yield output block refs in source order.
+
+    `sources` entries are either ObjectRefs of materialized blocks or
+    zero-arg callables yielding blocks (read tasks). With no stages,
+    materialized refs pass through without spawning tasks.
+    """
+    import cloudpickle
+
+    if not stages and all(isinstance(s, ray_tpu.ObjectRef) for s in sources):
+        yield from sources
         return
 
-    import cloudpickle
     stages_blob = cloudpickle.dumps(stages)
 
-    @ray_tpu.remote
-    def _fused(blob, block):
-        import cloudpickle as cp
-        return _apply_stages(block, cp.loads(blob))
+    remote_fn = ray_tpu.remote(num_returns="streaming")(_source_task_fn)
+    if resources:
+        remote_fn = remote_fn.options(resources=resources)
 
-    task = _fused.options(resources=resources) if resources else _fused
+    def _wire_source(s):
+        return s if isinstance(s, ray_tpu.ObjectRef) else \
+            cloudpickle.dumps(s)
 
-    pending: List[Any] = []
-    it = iter(input_refs)
-    exhausted = False
-    while True:
-        while not exhausted and len(pending) < window:
-            try:
-                ref = next(it)
-            except StopIteration:
-                exhausted = True
-                break
-            pending.append(task.remote(stages_blob, ref))
-        if not pending:
-            return
-        head = pending.pop(0)
-        yield head
+    window = max(1, window)
+    gens: List[Any] = []
+    idx = 0
+    # Prime the window, then drain generators in order, topping up as
+    # sources complete. Each active generator produces autonomously into
+    # its backpressure window.
+    while idx < len(sources) and len(gens) < window:
+        gens.append(remote_fn.remote(_wire_source(sources[idx]),
+                                     stages_blob))
+        idx += 1
+    while gens:
+        head = gens.pop(0)
+        for ref in head:
+            yield ref
+        if idx < len(sources) and len(gens) < window:
+            gens.append(remote_fn.remote(_wire_source(sources[idx]),
+                                         stages_blob))
+            idx += 1
 
 
-def execute_to_blocks(input_refs: List[Any], stages: List[Callable],
+def execute_to_blocks(sources: List[Any], stages: List[Stage],
                       window: int = DEFAULT_WINDOW) -> List[Any]:
-    return list(execute_streaming(input_refs, stages, window))
+    return list(execute_streaming(sources, stages, window))

@@ -1,12 +1,12 @@
 from ray_tpu.train.api_config import (CheckpointConfig, FailureConfig,
                                       Result, RunConfig, ScalingConfig)
 from ray_tpu.train.jax_trainer import JaxTrainer
-from ray_tpu.train.session import get_context, report
+from ray_tpu.train.session import get_context, get_dataset_shard, report
 from ray_tpu.train.spmd import (default_optimizer, make_train_fns,
                                 state_shardings)
 
 __all__ = [
     "CheckpointConfig", "FailureConfig", "JaxTrainer", "Result", "RunConfig",
-    "ScalingConfig", "default_optimizer", "get_context", "make_train_fns",
-    "report", "state_shardings",
+    "ScalingConfig", "default_optimizer", "get_context",
+    "get_dataset_shard", "make_train_fns", "report", "state_shardings",
 ]

@@ -39,14 +39,30 @@ class TrainingFailedError(RuntimeError):
 class TrainController:
     def __init__(self, train_loop, train_loop_config: Optional[dict],
                  scaling_config: ScalingConfig, run_config: RunConfig,
-                 worker_env: Optional[Dict[str, Optional[str]]] = None):
+                 worker_env: Optional[Dict[str, Optional[str]]] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
         self._fn_blob = cloudpickle.dumps(train_loop)
         self._config = train_loop_config
         self._scaling = scaling_config
         self._run_cfg = run_config
         self._worker_env = dict(worker_env or {})
+        self._datasets = dict(datasets or {})
         self._latest_checkpoint: Any = None
         self._metrics_history: List[Dict[str, Any]] = []
+
+    def _make_shards(self) -> List[Dict[str, Any]]:
+        """streaming_split every dataset across the group; one fresh split
+        per attempt (a restarted group must not resume half-consumed
+        iterators). Returns per-rank {name: DataIterator}."""
+        n = self._scaling.num_workers
+        per_rank: List[Dict[str, Any]] = [{} for _ in range(n)]
+        self._coordinators: List[Any] = []
+        for name, ds in self._datasets.items():
+            its = ds.streaming_split(n, equal=True)
+            self._coordinators.append(its[0]._coordinator)
+            for rank, it in enumerate(its):
+                per_rank[rank][name] = it
+        return per_rank
 
     # -- worker group lifecycle -----------------------------------------
     def _make_group(self, pg):
@@ -91,6 +107,15 @@ class TrainController:
                 ray_tpu.kill(w)
             except Exception:
                 pass
+        # Split coordinators are per-attempt: kill them or each restart
+        # leaks a worker process (and the blocks its parked streaming
+        # tasks pin in the object store).
+        for coord in getattr(self, "_coordinators", []):
+            try:
+                ray_tpu.kill(coord)
+            except Exception:
+                pass
+        self._coordinators = []
         try:
             ray_tpu.remove_placement_group(pg)
         except Exception:
@@ -126,12 +151,14 @@ class TrainController:
         workers: list = []
         try:
             workers = self._make_group(pg)
+            shards = self._make_shards()
             starts = [
                 w.start.remote(
                     self._fn_blob, self._config,
                     self._run_cfg.name, self._run_cfg.storage_path,
-                    self._latest_checkpoint)
-                for w in workers]
+                    self._latest_checkpoint,
+                    cloudpickle.dumps(shards[rank]))
+                for rank, w in enumerate(workers)]
             ray_tpu.get(starts, timeout=120)
             return self._poll_until_done(workers)
         except TrainingFailedError:

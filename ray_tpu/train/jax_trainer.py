@@ -34,15 +34,22 @@ class JaxTrainer:
                  train_loop_config: Optional[dict] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
                  worker_env: Optional[Dict[str, Optional[str]]] = None):
         """worker_env: extra env vars for every worker process (value None
         unsets a var). JAX reads its env at interpreter start, so platform
         selection (JAX_PLATFORMS, XLA_FLAGS, TPU_VISIBLE_CHIPS overrides)
-        must ride here rather than inside the train loop."""
+        must ride here rather than inside the train loop.
+
+        datasets: {name: ray_tpu.data.Dataset} — each is streaming_split
+        across the worker group (equal=True for SPMD step parity); the loop
+        reads its shard via ray_tpu.train.get_dataset_shard(name)
+        (reference: DataParallelTrainer datasets= + train v2 data ingest).
+        """
         self._controller = TrainController(
             train_loop_per_worker, train_loop_config,
             scaling_config or ScalingConfig(),
-            run_config or RunConfig(), worker_env)
+            run_config or RunConfig(), worker_env, datasets)
 
     def fit(self) -> Result:
         result = self._controller.run()

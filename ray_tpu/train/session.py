@@ -16,12 +16,14 @@ from typing import Any, Dict, List, Optional, Tuple
 class TrainContext:
     def __init__(self, rank: int, world_size: int,
                  experiment_name: str = "", storage_path: str = "",
-                 restored_checkpoint: Optional[Any] = None):
+                 restored_checkpoint: Optional[Any] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.rank = rank
         self.world_size = world_size
         self.experiment_name = experiment_name
         self.storage_path = storage_path
         self._restored_checkpoint = restored_checkpoint
+        self._dataset_shards = dict(dataset_shards or {})
 
     def get_world_rank(self) -> int:
         return self.rank
@@ -32,6 +34,15 @@ class TrainContext:
     def get_checkpoint(self) -> Optional[Any]:
         """Checkpoint to resume from (set on group restart), else None."""
         return self._restored_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        """This worker's DataIterator for the trainer's datasets= entry
+        (reference: ray.train.get_dataset_shard)."""
+        if name not in self._dataset_shards:
+            raise KeyError(
+                f"no dataset shard {name!r}; trainer datasets= had "
+                f"{sorted(self._dataset_shards)}")
+        return self._dataset_shards[name]
 
 
 class _Session:
@@ -73,6 +84,11 @@ def get_context() -> TrainContext:
     if _session is None:
         raise RuntimeError("not inside a train worker session")
     return _session.ctx
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's dataset shard (reference: ray.train.get_dataset_shard)."""
+    return get_context().get_dataset_shard(name)
 
 
 def report(metrics: Dict[str, Any], checkpoint: Optional[Any] = None) -> None:

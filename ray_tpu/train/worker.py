@@ -51,14 +51,17 @@ class TrainWorker:
     # -- controller API --------------------------------------------------
     def start(self, fn_blob: bytes, config: Optional[dict],
               experiment_name: str = "", storage_path: str = "",
-              restored_checkpoint: Any = None) -> None:
+              restored_checkpoint: Any = None,
+              shards_blob: Optional[bytes] = None) -> None:
         """Launch the user train loop in a thread and return immediately
         (the actor stays responsive to poll())."""
         assert self._thread is None, "start() called twice"
         rank = int(os.environ.get("RAY_TPU_TRAIN_RANK", "0"))
         world = int(os.environ.get("RAY_TPU_TRAIN_WORLD", "1"))
+        shards = cloudpickle.loads(shards_blob) if shards_blob else {}
         ctx = _session_mod.TrainContext(rank, world, experiment_name,
-                                        storage_path, restored_checkpoint)
+                                        storage_path, restored_checkpoint,
+                                        dataset_shards=shards)
         self._session = _session_mod._start_session(ctx)
         fn = cloudpickle.loads(fn_blob)
 

@@ -32,5 +32,5 @@ def _discard(task: "asyncio.Task") -> None:
         exc = task.exception()
         if exc is not None and not isinstance(exc, asyncio.CancelledError):
             import logging
-            logging.getLogger("ray_tpu.aio").debug(
-                "background task failed: %r", exc)
+            logging.getLogger("ray_tpu.aio").error(
+                "background task %r failed: %r", task.get_coro(), exc)
