@@ -1,12 +1,18 @@
 from ray_tpu.train.api_config import (CheckpointConfig, FailureConfig,
                                       Result, RunConfig, ScalingConfig)
+from ray_tpu.train.checkpointing import (Checkpoint, CheckpointManager,
+                                         load_checkpoint_host,
+                                         restore_checkpoint)
 from ray_tpu.train.jax_trainer import JaxTrainer
-from ray_tpu.train.session import get_context, get_dataset_shard, report
+from ray_tpu.train.session import (get_context, get_dataset_shard, report,
+                                   save_checkpoint)
 from ray_tpu.train.spmd import (default_optimizer, make_train_fns,
                                 state_shardings)
 
 __all__ = [
-    "CheckpointConfig", "FailureConfig", "JaxTrainer", "Result", "RunConfig",
-    "ScalingConfig", "default_optimizer", "get_context",
-    "get_dataset_shard", "make_train_fns", "report", "state_shardings",
+    "Checkpoint", "CheckpointConfig", "CheckpointManager", "FailureConfig",
+    "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
+    "default_optimizer", "get_context", "get_dataset_shard",
+    "load_checkpoint_host", "make_train_fns", "report",
+    "restore_checkpoint", "save_checkpoint", "state_shardings",
 ]

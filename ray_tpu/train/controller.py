@@ -17,6 +17,7 @@ group with the latest reported checkpoint.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -49,6 +50,22 @@ class TrainController:
         self._datasets = dict(datasets or {})
         self._latest_checkpoint: Any = None
         self._metrics_history: List[Dict[str, Any]] = []
+        # Top-K retention + auto-resume over the run's storage path
+        # (reference: checkpoint_manager.py owned by the controller).
+        self._ckpt_manager = None
+        if run_config.storage_path:
+            from ray_tpu.train.checkpointing import (CheckpointManager,
+                                                     run_dir)
+            ccfg = run_config.checkpoint_config
+            self._ckpt_manager = CheckpointManager(
+                run_dir(run_config.storage_path, run_config.name),
+                max_to_keep=ccfg.num_to_keep,  # None = keep all
+                metric=ccfg.checkpoint_score_attribute,
+                mode=ccfg.checkpoint_score_order)
+            latest = self._ckpt_manager.latest()
+            if latest is not None:  # auto-resume from a prior run
+                logger.info("auto-resuming from %s", latest)
+                self._latest_checkpoint = latest
 
     def _make_shards(self) -> List[Dict[str, Any]]:
         """streaming_split every dataset across the group; one fresh split
@@ -182,7 +199,19 @@ class TrainController:
                     if rank == 0:
                         self._metrics_history.append(metrics)
                     if ckpt is not None:
-                        self._latest_checkpoint = ckpt
+                        # Ranks drain independently: only advance, never
+                        # regress, the resume point.
+                        new_step = getattr(ckpt, "step", None)
+                        cur_step = getattr(self._latest_checkpoint, "step",
+                                           None)
+                        if (new_step is None or cur_step is None
+                                or new_step >= cur_step):
+                            self._latest_checkpoint = ckpt
+                        if rank == 0 and self._ckpt_manager is not None:
+                            from ray_tpu.train.checkpointing import \
+                                Checkpoint
+                            if isinstance(ckpt, Checkpoint):
+                                self._ckpt_manager.register(ckpt)
             errs = [(i, p["error"]) for i, p in enumerate(polls)
                     if p["status"] == "error"]
             if errs:

@@ -91,6 +91,20 @@ def get_dataset_shard(name: str = "train"):
     return get_context().get_dataset_shard(name)
 
 
+def save_checkpoint(state: Any, step: int,
+                    metrics: Optional[Dict[str, Any]] = None):
+    """Sharded save of a jax pytree into the run's storage path; call from
+    EVERY rank (per-host shard writes + commit barrier), then report the
+    returned handle: ``report(metrics, checkpoint=save_checkpoint(...))``."""
+    from ray_tpu.train.checkpointing import run_dir
+    from ray_tpu.train.checkpointing import save_checkpoint as _save
+    ctx = get_context()
+    if not ctx.storage_path:
+        raise RuntimeError("RunConfig.storage_path is not set")
+    return _save(run_dir(ctx.storage_path, ctx.experiment_name), state,
+                 step, metrics)
+
+
 def report(metrics: Dict[str, Any], checkpoint: Optional[Any] = None) -> None:
     """Report metrics (and optionally a checkpoint) from the train loop.
 

@@ -1,0 +1,149 @@
+"""Checkpointing: sharded save/restore correctness, commit atomicity,
+top-K retention, and trainer crash-resume.
+
+Mirrors the reference's checkpoint coverage (reference:
+train/v2/tests/test_checkpoint_manager.py + SURVEY §5.4's Orbax-style
+per-host shard writes + commit barrier) on the virtual 8-device CPU mesh.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+from ray_tpu.train.checkpointing import (Checkpoint, CheckpointManager,
+                                         load_checkpoint_host,
+                                         restore_checkpoint,
+                                         save_checkpoint)
+
+
+def _sharded_state():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "tp"))
+    w = jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                       NamedSharding(mesh, P("dp", "tp")))
+    b = jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P("tp")))
+    rep = jax.device_put(jnp.float32(3.5), NamedSharding(mesh, P()))
+    return {"layer": {"w": w, "b": b}, "scale": rep, "step": 7}
+
+
+def test_sharded_save_restore_roundtrip(tmp_path):
+    state = _sharded_state()
+    ckpt = save_checkpoint(str(tmp_path), state, step=7)
+    assert ckpt.is_valid()
+
+    # Restore into a zeroed target with the SAME shardings.
+    import jax
+    import jax.numpy as jnp
+    target = jax.tree.map(
+        lambda x: jnp.zeros_like(x) if isinstance(x, jax.Array) else 0,
+        state)
+    restored = restore_checkpoint(ckpt, target)
+    np.testing.assert_array_equal(np.asarray(restored["layer"]["w"]),
+                                  np.arange(64.0).reshape(8, 8))
+    np.testing.assert_array_equal(np.asarray(restored["layer"]["b"]),
+                                  np.arange(8.0))
+    assert float(restored["scale"]) == 3.5
+    assert int(restored["step"]) == 7
+    # Shardings preserved.
+    assert restored["layer"]["w"].sharding == state["layer"]["w"].sharding
+
+
+def test_host_assembly(tmp_path):
+    state = _sharded_state()
+    ckpt = save_checkpoint(str(tmp_path), state, step=1)
+    host = load_checkpoint_host(ckpt)
+    np.testing.assert_array_equal(host["layer.w"],
+                                  np.arange(64.0).reshape(8, 8))
+    np.testing.assert_array_equal(host["layer.b"], np.arange(8.0))
+
+
+def test_uncommitted_checkpoint_rejected(tmp_path):
+    state = _sharded_state()
+    ckpt = save_checkpoint(str(tmp_path), state, step=2)
+    os.unlink(os.path.join(ckpt.path, "COMMIT"))
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(ckpt, state)
+    # And the manager must not discover it.
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.latest() is None
+
+
+def test_manager_topk_by_metric(tmp_path):
+    state = {"x": np.arange(4.0)}
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2, metric="loss",
+                            mode="min")
+    paths = []
+    for step, loss in [(1, 5.0), (2, 2.0), (3, 9.0), (4, 1.0)]:
+        c = save_checkpoint(str(tmp_path), state, step,
+                            metrics={"loss": loss})
+        mgr.register(c)
+        paths.append(c.path)
+    kept = {c.step for c in mgr.checkpoints()}
+    assert kept == {2, 4}  # two lowest losses survive
+    assert mgr.best().step == 4
+    assert not os.path.exists(paths[0])  # pruned from disk
+    # A fresh manager over the same dir rediscovers the survivors.
+    mgr2 = CheckpointManager(str(tmp_path), max_to_keep=2)
+    assert {c.step for c in mgr2.checkpoints()} == {2, 4}
+    assert mgr2.latest().step == 4
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(num_nodes=1, resources={"CPU": 8})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_trainer_crash_resume(cluster, tmp_path):
+    """Kill the train loop mid-run; the restarted group must resume from
+    the last committed checkpoint and CONTINUE (not restart from step 0)."""
+    from ray_tpu.train import (FailureConfig, JaxTrainer, RunConfig,
+                               ScalingConfig)
+
+    storage = str(tmp_path)
+
+    def loop(config):
+        import jax.numpy as jnp
+
+        import ray_tpu.train as rt
+        ctx = rt.get_context()
+        start_step = 0
+        w = jnp.zeros(4)
+        prev = ctx.get_checkpoint()
+        if prev is not None:
+            host = rt.load_checkpoint_host(prev)
+            start_step = int(host["step"]) + 1
+            w = jnp.asarray(host["w"])
+        for step in range(start_step, 6):
+            w = w + 1.0  # "training"
+            ckpt = rt.save_checkpoint({"w": w, "step": step}, step,
+                                      metrics={"step": step})
+            rt.report({"step": step, "w0": float(w[0]),
+                       "resumed_from": start_step}, checkpoint=ckpt)
+            if step == 2 and prev is None:
+                raise RuntimeError("simulated crash after step 2")
+
+    trainer = JaxTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1, use_tpu=False),
+        run_config=RunConfig(name="resume_test", storage_path=storage,
+                             failure_config=FailureConfig(max_failures=1)),
+        worker_env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None},
+    )
+    result = trainer.fit()
+    hist = result.metrics_history
+    # Second attempt resumed at step 3 (not 0) and finished at step 5.
+    resumed = [m for m in hist if m["resumed_from"] > 0]
+    assert resumed, f"never resumed from checkpoint: {hist}"
+    assert resumed[0]["resumed_from"] == 3
+    assert hist[-1]["step"] == 5
+    # w accumulated across the crash: step k ends with w0 == k+1.
+    assert hist[-1]["w0"] == 6.0
