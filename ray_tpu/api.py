@@ -134,6 +134,7 @@ class ActorClass:
             name=opts.get("name", ""),
             max_restarts=opts.get("max_restarts", 0),
             max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=opts.get("max_concurrency", 0),
             # Actors hold 0 CPU at rest by default (reference behavior) so a
             # small node isn't starved of task leases by resident actors.
             resources=_resources_from_opts(opts, default_cpu=0.0),

@@ -1046,7 +1046,8 @@ class CoreWorker:
                      max_restarts: int = 0, max_task_retries: int = 0,
                      resources: Optional[dict] = None, placement_group=None,
                      pg_bundle_index: int = -1,
-                     runtime_env: Optional[dict] = None) -> ActorHandle:
+                     runtime_env: Optional[dict] = None,
+                     max_concurrency: int = 0) -> ActorHandle:
         actor_id = ActorID.random()
         self._ensure_actor_sub()
         held: List[ObjectRef] = []
@@ -1055,6 +1056,7 @@ class CoreWorker:
             "args": self._serialize_args(args, kwargs, held),
             "actor_id": actor_id.binary(),
             "max_restarts": max_restarts,
+            "max_concurrency": max_concurrency,
         }
         self._actor_arg_refs[actor_id.binary()] = held
         spec_blob = cloudpickle.dumps(creation)
@@ -1229,6 +1231,25 @@ class CoreWorker:
         self._actor_instance = instance
         self._actor_id = creation["actor_id"]
         self._is_actor_worker = True
+        # ASYNC ACTOR (reference: _raylet.pyx async actors + fiber.h):
+        # any coroutine method makes the actor async — its async methods
+        # run CONCURRENTLY on the io loop (unordered, capped by
+        # max_concurrency), sync methods still serialize in the exec pool.
+        # Detection scans the CLASS statically: instance getattr would
+        # trigger property getters, and __call__-only async actors count.
+        import inspect
+
+        def _is_coro_attr(name: str) -> bool:
+            f = inspect.getattr_static(cls, name, None)
+            if isinstance(f, (staticmethod, classmethod)):
+                f = f.__func__
+            return inspect.iscoroutinefunction(f)
+
+        self._actor_is_async = any(
+            _is_coro_attr(m) for m in dir(cls)
+            if not m.startswith("__") or m == "__call__")
+        self._actor_sem = asyncio.Semaphore(
+            int(creation.get("max_concurrency") or 1000))
 
     def _record_exec_thread(self) -> None:
         self._exec_thread_id = threading.get_ident()
@@ -1253,6 +1274,11 @@ class CoreWorker:
     @long_poll
     async def push_task(self, spec_blob: bytes) -> dict:
         spec: TaskSpec = cloudpickle.loads(spec_blob)
+        if spec.is_actor_task and getattr(self, "_actor_is_async", False):
+            # Async actors execute unordered + concurrently (reference:
+            # async actor semantics — ordering is explicitly dropped).
+            async with self._actor_sem:
+                return await self._execute(spec)
         if spec.is_actor_task:
             # Enforce per-caller seqno ordering (reference:
             # task_execution/actor_scheduling_queue.cc). Each out-of-order
@@ -1268,6 +1294,8 @@ class CoreWorker:
             return await self._execute(spec)
         finally:
             if spec.is_actor_task:
+                # Advance even if a stale/lower seqno arrived (dedup'd
+                # upstream); the successor waiter is keyed exactly.
                 self._actor_seqno[spec.caller_id] = spec.seqno + 1
                 waiters = self._actor_waiters.get(spec.caller_id)
                 if waiters:
@@ -1307,8 +1335,12 @@ class CoreWorker:
                 from ray_tpu.core.common import TaskCancelledError
                 raise TaskCancelledError(f"task {spec.name} cancelled")
             args, kwargs = await self._resolve_args(spec.args)
+            async_method = None
             if spec.is_actor_task:
                 method = getattr(self._actor_instance, spec.method_name)
+                import inspect as _inspect
+                if _inspect.iscoroutinefunction(method):
+                    async_method = method
                 user_fn = lambda: method(*args, **kwargs)  # noqa: E731
             else:
                 func = await self._load_function(spec.func_id)
@@ -1333,7 +1365,12 @@ class CoreWorker:
 
             if spec.streaming:
                 return await self._execute_streaming(spec, user_fn)
-            result = await loop.run_in_executor(self._exec_pool, fn)
+            if async_method is not None:
+                # Async actor method: runs on the io loop, concurrent with
+                # other async methods (no exec-pool hop, no ordering).
+                result = await async_method(*args, **kwargs)
+            else:
+                result = await loop.run_in_executor(self._exec_pool, fn)
         except BaseException as e:  # user error -> error payload to owner
             from ray_tpu.core.common import TaskCancelledError
             tb = traceback.format_exc()

@@ -1,0 +1,32 @@
+"""ray_tpu.serve — model serving on the actor runtime.
+
+Analogue of Ray Serve (reference: python/ray/serve/ — ServeController
+controller.py:103, HTTPProxy proxy.py:706, Router router.py:433 +
+pow_2_router.py:27, ReplicaActor replica.py:1095, @serve.batch
+batching.py), rebuilt TPU-first on async actors: replicas handle requests
+concurrently on their io loop, routers pick replicas by
+power-of-two-choices over live queue lengths, and JAX model replicas batch
+via @serve.batch so the MXU sees full batches.
+
+    import ray_tpu.serve as serve
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        async def __call__(self, request):
+            return request
+
+    serve.run(Echo.bind(), name="echo")
+    handle = serve.get_deployment_handle("echo")
+    out = handle.remote({"x": 1}).result()
+"""
+
+from ray_tpu.serve.api import (Application, Deployment, batch, delete,
+                               deployment, get_deployment_handle, get_proxy,
+                               run, shutdown, start)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application", "Deployment", "DeploymentHandle", "DeploymentResponse",
+    "batch", "delete", "deployment", "get_deployment_handle", "get_proxy",
+    "run", "shutdown", "start",
+]

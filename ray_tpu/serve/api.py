@@ -1,0 +1,217 @@
+"""Public Serve API: @deployment, bind, run, handles, @batch.
+
+Analogue of the reference's surface (reference: serve/api.py serve.run:685,
+serve/deployment.py Deployment/@serve.deployment, serve/batching.py
+@serve.batch). The controller is a named detached-style actor; deploys are
+idempotent upserts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class Application:
+    """A bound deployment (class + init args), deployable via serve.run
+    (reference: Application returned by Deployment.bind)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, cls: type, name: str, config: Dict[str, Any]):
+        self._cls = cls
+        self.name = name
+        self._config = config
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, **overrides) -> "Deployment":
+        cfg = dict(self._config)
+        name = overrides.pop("name", self.name)
+        cfg.update(overrides)
+        return Deployment(self._cls, name, cfg)
+
+
+def deployment(cls: Optional[type] = None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 100,
+               num_cpus: Optional[float] = None, num_tpus: float = 0,
+               autoscaling_config: Optional[dict] = None):
+    """@serve.deployment decorator (reference: serve/deployment.py)."""
+
+    def wrap(c: type) -> Deployment:
+        return Deployment(c, name or c.__name__, {
+            "num_replicas": num_replicas,
+            "max_ongoing_requests": max_ongoing_requests,
+            "num_cpus": num_cpus,
+            "num_tpus": num_tpus,
+            "autoscaling_config": autoscaling_config,
+        })
+
+    return wrap(cls) if cls is not None else wrap
+
+
+# ---------------------------------------------------------------------------
+# controller lifecycle
+# ---------------------------------------------------------------------------
+
+_controller_handle = None
+_proxy = None
+
+
+def start(*, http: bool = False, http_port: int = 0,
+          http_host: str = "127.0.0.1"):
+    """Ensure the Serve controller (and optionally the HTTP proxy) is up."""
+    global _controller_handle, _proxy
+    if _controller_handle is None:
+        try:
+            _controller_handle = ray_tpu.get_actor(
+                ServeController.CONTROLLER_NAME)
+        except ValueError:
+            _controller_handle = ray_tpu.remote(ServeController).options(
+                name=ServeController.CONTROLLER_NAME,
+                max_restarts=1).remote()
+            # Wait until it answers.
+            ray_tpu.get(_controller_handle.routing_version.remote(),
+                        timeout=60)
+    if http and _proxy is None:
+        from ray_tpu.serve.proxy import HttpProxy
+        _proxy = HttpProxy(_controller_handle, http_host, http_port)
+    return _controller_handle
+
+
+def get_proxy():
+    """The in-process HTTP proxy started by serve.start(http=True)."""
+    return _proxy
+
+
+def run(app: "Application | Deployment", *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
+    """Deploy (upsert) an application; blocks until replicas are live
+    (reference: serve.run, serve/api.py:685)."""
+    controller = start()
+    if isinstance(app, Deployment):
+        app = app.bind()
+    dep = app.deployment
+    dep_name = name or dep.name
+    config = dict(dep._config)
+    config["cls_blob"] = cloudpickle.dumps(dep._cls)
+    config["init_args_blob"] = cloudpickle.dumps(
+        (app.init_args, app.init_kwargs))
+    config["route_prefix"] = route_prefix or f"/{dep_name}"
+    ray_tpu.get(controller.deploy.remote(dep_name,
+                                         cloudpickle.dumps(config)),
+                timeout=120)
+    handle = DeploymentHandle(dep_name, controller)
+    # Block until at least one replica has PASSED a health check (heavy
+    # init — model load + XLA compile — happens in the constructor).
+    deadline = time.monotonic() + 600
+    while time.monotonic() < deadline:
+        try:
+            if ray_tpu.get(controller.ready_replicas.remote(dep_name),
+                           timeout=30) > 0:
+                handle._router._refresh(force=True)
+                return handle
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(f"deployment {dep_name!r} never became ready")
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    controller = start()
+    return DeploymentHandle(name, controller)
+
+
+def delete(name: str) -> None:
+    controller = start()
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def shutdown() -> None:
+    global _controller_handle, _proxy
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None
+    if _controller_handle is not None:
+        try:
+            ray_tpu.get(_controller_handle.shutdown_serve.remote(),
+                        timeout=30)
+            ray_tpu.kill(_controller_handle)
+        except Exception:
+            pass
+        _controller_handle = None
+
+
+# ---------------------------------------------------------------------------
+# @serve.batch (reference: serve/batching.py)
+# ---------------------------------------------------------------------------
+
+def batch(fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Coalesce concurrent single calls into one batched call: the wrapped
+    async method receives a LIST of inputs and must return a list of
+    outputs in order. Essential for JAX replicas — the MXU wants full
+    batches, and XLA recompiles per batch size, so sizes are capped at
+    max_batch_size (padding to fixed shapes is the model's concern)."""
+
+    def wrap(f: Callable):
+        # Per-instance queue stored ON the instance (a closure-level lock
+        # would make the deployment class unpicklable; and replica async
+        # methods all run on one io loop, so no lock is needed).
+        attr = f"__serve_batch_queue_{f.__name__}"
+
+        async def flush(self_obj):
+            batch_items = getattr(self_obj, attr, None)
+            if not batch_items:
+                return
+            setattr(self_obj, attr, [])
+            inputs = [i for i, _ in batch_items]
+            try:
+                outputs = await f(self_obj, inputs)
+                assert len(outputs) == len(inputs), \
+                    "@batch fn must return one output per input"
+                for (_, fut), out in zip(batch_items, outputs):
+                    if not fut.done():
+                        fut.set_result(out)
+            except BaseException as e:  # noqa: BLE001
+                for _, fut in batch_items:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+        @functools.wraps(f)
+        async def wrapper(self_obj, item):
+            fut = asyncio.get_running_loop().create_future()
+            q = getattr(self_obj, attr, None)
+            if q is None:
+                q = []
+                setattr(self_obj, attr, q)
+            q.append((item, fut))
+            if len(q) >= max_batch_size:
+                await flush(self_obj)
+            else:
+                from ray_tpu.utils.aio import spawn
+
+                async def delayed():
+                    await asyncio.sleep(batch_wait_timeout_s)
+                    await flush(self_obj)
+                spawn(delayed())
+            return await fut
+
+        return wrapper
+
+    return wrap(fn) if fn is not None else wrap

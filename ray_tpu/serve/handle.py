@@ -1,0 +1,141 @@
+"""DeploymentHandle + Router — the data-plane client.
+
+Analogue of the reference's handle/router (reference: serve/handle.py
+DeploymentHandle, serve/_private/router.py Router:433, request_router/
+pow_2_router.py PowerOfTwoChoicesRequestRouter:27): each handle owns a
+router that picks a replica per request by power-of-two-choices — probe
+two random replicas' queue lengths, send to the shorter — with a local
+routing-table cache refreshed on version bumps and on replica failure.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference: serve/handle.py
+    DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = None):
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class Router:
+    """Pow-2 replica chooser with cached routing table."""
+
+    _TABLE_TTL_S = 2.0
+
+    _QLEN_TTL_S = 0.1  # probe cache: bounds probe RPCs to ~20/s per pair
+
+    def __init__(self, deployment: str, controller_handle):
+        self._deployment = deployment
+        self._controller = controller_handle
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._checked = 0.0
+        self._lock = threading.Lock()
+        self._qlen_cache: Dict[bytes, tuple] = {}  # aid -> (qlen, ts)
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._checked < self._TABLE_TTL_S \
+                    and self._replicas:
+                return
+            self._checked = now
+            table = ray_tpu.get(self._controller.routing_table.remote(),
+                                timeout=30)
+            if table["version"] != self._version:
+                self._version = table["version"]
+                self._replicas = table["deployments"].get(
+                    self._deployment, [])
+
+    def choose_replica(self):
+        """Power-of-two-choices over live queue lengths (reference:
+        pow_2_router.py:52 choose_replicas)."""
+        self._refresh()
+        replicas = self._replicas
+        if not replicas:
+            raise RuntimeError(
+                f"deployment {self._deployment!r} has no replicas")
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        try:
+            qa = self._queue_len(a)
+            qb = self._queue_len(b)
+        except Exception:
+            self._refresh(force=True)
+            return random.choice(self._replicas or replicas)
+        return a if qa <= qb else b
+
+    def _queue_len(self, replica) -> int:
+        """Cached queue-length probe: a hot request path must not pay two
+        RPC round trips per request (reference routers cache replica
+        load similarly)."""
+        aid = replica.actor_id.binary()
+        now = time.monotonic()
+        hit = self._qlen_cache.get(aid)
+        if hit is not None and now - hit[1] < self._QLEN_TTL_S:
+            return hit[0]
+        q = ray_tpu.get(replica.queue_len.remote(), timeout=5)
+        self._qlen_cache[aid] = (q, now)
+        return q
+
+    def on_replica_error(self) -> None:
+        self._refresh(force=True)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment: str, controller_handle,
+                 method: str = "__call__"):
+        self._deployment = deployment
+        self._controller = controller_handle
+        self._method = method
+        self._router = Router(deployment, controller_handle)
+
+    def options(self, *, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self._deployment, self._controller,
+                             method_name)
+        h._router = self._router  # share the routing cache
+        return h
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        blob = cloudpickle.dumps((args, kwargs))
+        last_exc: Optional[Exception] = None
+        for _ in range(3):  # retry across replica failures
+            replica = self._router.choose_replica()
+            try:
+                ref = replica.handle_request.remote(self._method, blob)
+                return DeploymentResponse(ref)
+            except Exception as e:
+                last_exc = e
+                self._router.on_replica_error()
+        raise RuntimeError(
+            f"could not route request to {self._deployment!r}: {last_exc!r}")
+
+    def stream(self, *args, **kwargs):
+        """Streaming call: the deployment method must be a generator;
+        yields values as the replica produces them (reference: Serve
+        streaming responses over ObjectRefGenerator)."""
+        blob = cloudpickle.dumps((args, kwargs))
+        replica = self._router.choose_replica()
+        gen = replica.handle_request_streaming.options(
+            num_returns="streaming").remote(self._method, blob)
+        for ref in gen:
+            yield ray_tpu.get(ref)

@@ -1,0 +1,228 @@
+"""HTTP proxy — the ingress data plane.
+
+Analogue of the reference's proxy (reference: serve/_private/proxy.py
+HTTPProxy:706 — ASGI server resolving routes to deployment handles,
+streaming responses). Minimal asyncio HTTP/1.1 server: POST/GET
+/{route_prefix} with a JSON body dispatches to the deployment's handle
+via the pow-2 router; generator deployments stream chunked responses.
+Run one per node (reference runs one ProxyActor per node).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.utils import get_logger
+
+logger = get_logger("serve.proxy")
+
+
+class HttpProxy:
+    def __init__(self, controller_handle, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._controller = controller_handle
+        self._host = host
+        self.port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._routes: Dict[str, str] = {}  # route_prefix -> deployment
+        self._routes_version = -1
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve_thread,
+                                        daemon=True, name="http-proxy")
+        self._thread.start()
+        self._started.wait(30)
+
+    # -- server plumbing -------------------------------------------------
+    def _serve_thread(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        server = self._loop.run_until_complete(
+            asyncio.start_server(self._on_client, self._host, self.port))
+        self.port = server.sockets[0].getsockname()[1]
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            server.close()
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+    # -- routing ---------------------------------------------------------
+    _NEG_CACHE_TTL_S = 2.0  # unknown-path probes must not hammer refresh
+
+    def _refresh_routes(self) -> None:
+        table = ray_tpu.get(self._controller.list_deployments.remote(),
+                            timeout=10)
+        self._routes = {}
+        for name, info in table.items():
+            prefix = info["config"].get("route_prefix") or f"/{name}"
+            self._routes[prefix] = name
+
+    def _match(self, path: str) -> Optional[str]:
+        # Longest-prefix match (reference: proxy route resolution).
+        return max((p for p in self._routes
+                    if path == p or path.startswith(p + "/")),
+                   key=len, default=None)
+
+    async def _handle_for(self, path: str) -> Optional[DeploymentHandle]:
+        match = self._match(path)
+        if match is None:
+            # Refresh OFF the event loop (a blocking controller RPC here
+            # would stall every in-flight connection), rate-limited so
+            # 404 scans can't DoS the ingress.
+            import time as _time
+            now = _time.monotonic()
+            if now - getattr(self, "_last_refresh", 0.0) \
+                    > self._NEG_CACHE_TTL_S:
+                self._last_refresh = now
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._refresh_routes)
+                match = self._match(path)
+        if match is None:
+            return None
+        name = self._routes[match]
+        if name not in self._handles:
+            self._handles[name] = DeploymentHandle(name, self._controller)
+        return self._handles[name]
+
+    # -- request handling -------------------------------------------------
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    return
+                method, path, headers, body = request
+                await self._dispatch(method, path, headers, body, writer)
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    async def _read_request(self, reader: asyncio.StreamReader):
+        try:
+            line = await reader.readline()
+        except (ConnectionResetError, asyncio.LimitOverrunError):
+            return None
+        if not line:
+            return None
+        try:
+            method, path, _version = line.decode().split()
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", 0))
+        body = await reader.readexactly(length) if length else b""
+        return method, path, headers, body
+
+    @staticmethod
+    def _respond(writer: asyncio.StreamWriter, status: int, payload: bytes,
+                 content_type: str = "application/json") -> None:
+        reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}
+        writer.write(
+            f"HTTP/1.1 {status} {reason.get(status, '')}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+            f"Connection: keep-alive\r\n\r\n".encode() + payload)
+
+    async def _dispatch(self, method: str, path: str, headers, body: bytes,
+                        writer: asyncio.StreamWriter) -> None:
+        if path == "/-/healthz":
+            self._respond(writer, 200, b'{"status":"ok"}')
+            await writer.drain()
+            return
+        if path == "/-/routes":
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._refresh_routes)
+            self._respond(writer, 200, json.dumps(self._routes).encode())
+            await writer.drain()
+            return
+        handle = await self._handle_for(path)
+        if handle is None:
+            self._respond(writer, 404,
+                          json.dumps({"error": f"no route for {path}"})
+                          .encode())
+            await writer.drain()
+            return
+        try:
+            payload = json.loads(body) if body else None
+        except json.JSONDecodeError:
+            payload = body.decode(errors="replace")
+        loop = asyncio.get_running_loop()
+        stream = headers.get("x-serve-stream", "").lower() in ("1", "true")
+        if stream:
+            # Stream errors terminate the chunked body/connection; a 500
+            # status after chunks were sent would corrupt the protocol.
+            await self._stream_response(handle, payload, writer, loop)
+            return
+        try:
+            response = await loop.run_in_executor(
+                None, lambda: handle.remote(payload).result(timeout=120))
+            self._respond(writer, 200, json.dumps(
+                {"result": response}).encode())
+        except Exception as e:
+            self._respond(writer, 500,
+                          json.dumps({"error": repr(e)}).encode())
+        await writer.drain()
+
+    async def _stream_response(self, handle, payload, writer,
+                               loop) -> None:
+        """Chunked transfer from a streaming deployment method — tokens
+        flow as the replica yields (TTFT = first chunk)."""
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/plain\r\n"
+                     b"Transfer-Encoding: chunked\r\n\r\n")
+        await writer.drain()
+        q: asyncio.Queue = asyncio.Queue()
+        gone = threading.Event()  # client disconnected: stop the producer
+
+        def pump():
+            try:
+                it = handle.stream(payload)
+                for item in it:
+                    if gone.is_set():
+                        close = getattr(it, "close", None)
+                        if close:
+                            close()  # releases the replica-side stream
+                        return
+                    loop.call_soon_threadsafe(q.put_nowait, ("item", item))
+            except BaseException as e:  # noqa: BLE001
+                loop.call_soon_threadsafe(q.put_nowait, ("err", repr(e)))
+            finally:
+                loop.call_soon_threadsafe(q.put_nowait, ("end", None))
+
+        threading.Thread(target=pump, daemon=True).start()
+        try:
+            while True:
+                kind, item = await q.get()
+                if kind == "end":
+                    break
+                if kind == "err":
+                    chunk = json.dumps({"error": item}).encode()
+                else:
+                    chunk = (item if isinstance(item, (bytes, bytearray))
+                             else str(item).encode())
+                writer.write(f"{len(chunk):x}\r\n".encode() + chunk
+                             + b"\r\n")
+                await writer.drain()
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            gone.set()  # don't decode for a client that left
+            raise
