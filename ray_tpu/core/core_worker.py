@@ -1477,7 +1477,14 @@ class CoreWorker:
                 self._exec_current = None
 
         try:
-            total = await loop.run_in_executor(self._exec_pool, run_gen)
+            # Async actors stream CONCURRENTLY (default thread pool): a
+            # long-running generator must not head-of-line-block the
+            # single ordered exec thread — two clients streaming from one
+            # replica each get their own producer thread. Sync actors
+            # keep the ordered exec pool.
+            pool = None if getattr(self, "_actor_is_async", False) \
+                else self._exec_pool
+            total = await loop.run_in_executor(pool, run_gen)
         except BaseException as e:
             tb = traceback.format_exc()
             err = e if isinstance(e, TaskCancelledError) else \

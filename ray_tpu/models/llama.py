@@ -275,16 +275,20 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
         manual = {"pp"} | ({"sp"} if sp_manual else set())
         param_spec = jax.tree.map(lambda _: P("pp"), stage_layers)
         mb_spec = P(None, None, "sp", None) if sp_manual else P()
+        def _pipe_body(sp_params, mb):
+            out, aux = gpipe_spmd(stage_fn, sp_params, mb,
+                                  axis_name="pp", with_aux=True)
+            if sp_manual:
+                aux = jax.lax.pmean(aux, "sp")
+            return out, aux
+
+        aux_spec = P()
         pipe = jax.shard_map(
-            # TODO(pp+moe): the GPipe state is a single activation tensor, so
-            # the per-stage MoE aux loss is dropped under pipeline parallelism.
-            lambda sp_params, mb: gpipe_spmd(
-                lambda p, xx: stage_fn(p, xx)[0], sp_params, mb,
-                axis_name="pp"),
-            mesh=ctx.mesh, in_specs=(param_spec, mb_spec), out_specs=mb_spec,
-            axis_names=manual)
-        x = pipe(stage_layers, x_mb).reshape(B, *x.shape[1:])
-        aux = jnp.zeros((), jnp.float32)
+            _pipe_body,
+            mesh=ctx.mesh, in_specs=(param_spec, mb_spec),
+            out_specs=(mb_spec, aux_spec), axis_names=manual)
+        x, aux = pipe(stage_layers, x_mb)
+        x = x.reshape(B, *x.shape[2:])
     elif sp_manual:
         def _stack_pmean_aux(lp, xx):
             y, aux = _stack_fwd(lp, xx, cos, sin, cfg, True)

@@ -31,24 +31,28 @@ def _vary(x, axis_name):
         return jax.lax.pvary(x, (axis_name,))
 
 
-def gpipe_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
+def gpipe_spmd(stage_fn: Callable[[Any, jax.Array], "tuple[jax.Array, jax.Array] | jax.Array"],
                stage_params: Any,
                microbatches: jax.Array,
                *,
-               axis_name: str = "pp") -> jax.Array:
+               axis_name: str = "pp",
+               with_aux: bool = False):
     """GPipe forward over the pp axis. Call inside shard_map (manual on pp).
 
-    stage_fn(params_local, x) -> y with x, y of one microbatch's shape.
+    stage_fn(params_local, x) -> y (or (y, aux_scalar) with with_aux=True)
+      with x, y of one microbatch's shape.
     stage_params: pytree whose leaves have a leading stacked-stage axis of
       local size 1 (sharded P("pp") on that axis by the caller's in_specs).
     microbatches: [M, mb, ...] — replicated across pp.
-    Returns [M, mb, ...] outputs of the final stage, broadcast to all stages.
+    Returns [M, mb, ...] outputs of the final stage broadcast to all
+    stages; with_aux=True also returns the per-stage aux summed over the
+    pp axis and averaged over microbatches (warmup/drain steps, whose
+    inputs are bubble garbage, are excluded).
     """
     pp = jax.lax.psum(1, axis_name)
     stage = jax.lax.axis_index(axis_name)
     params_local = jax.tree.map(lambda p: p[0], stage_params)
     num_mb = microbatches.shape[0]
-    mb_shape = microbatches.shape[1:]
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
     # mb_in: cast to pp-varying; init buffers derive from it (times zero) so
@@ -56,15 +60,24 @@ def gpipe_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
     mb_in = _vary(microbatches, axis_name)
     out0 = mb_in * 0
     state0 = out0[0]
+    # Scalar zero derived from out0 so it inherits the manual-axis varying
+    # type (same idiom as the model's aux accumulator).
+    aux0 = (out0[(0,) * out0.ndim] * 0).astype(jnp.float32)
 
     def step(carry, t):
-        state, outputs = carry
+        state, outputs, aux_acc = carry
         mb_idx = jnp.clip(t, 0, num_mb - 1)
         x_in = jnp.where(stage == 0,
                          jax.lax.dynamic_index_in_dim(mb_in, mb_idx, 0,
                                                       keepdims=False),
                          state)
-        y = stage_fn(params_local, x_in)
+        res = stage_fn(params_local, x_in)
+        y, aux = res if with_aux else (res, jnp.zeros((), jnp.float32))
+        # This stage computes REAL microbatches only for t in
+        # [stage, stage + num_mb); outside that window it chews bubble
+        # garbage whose aux must not count.
+        active = (t >= stage) & (t - stage < num_mb)
+        aux_acc = aux_acc + jnp.where(active, aux, 0.0)
         out_idx = t - (pp - 1)
         valid = (stage == pp - 1) & (out_idx >= 0)
         safe_idx = jnp.clip(out_idx, 0, num_mb - 1)
@@ -72,10 +85,16 @@ def gpipe_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
         outputs = jax.lax.dynamic_update_index_in_dim(
             outputs, jnp.where(valid, y, prev), safe_idx, 0)
         state = jax.lax.ppermute(y, axis_name, perm)
-        return (state, outputs), None
+        return (state, outputs, aux_acc), None
 
-    (_, outputs), _ = jax.lax.scan(
-        step, (state0, out0), jnp.arange(num_mb + pp - 1))
+    (_, outputs, aux_acc), _ = jax.lax.scan(
+        step, (state0, out0, aux0), jnp.arange(num_mb + pp - 1))
     # Broadcast final-stage outputs to every stage (indicator + psum).
     mask = (stage == pp - 1).astype(outputs.dtype)
-    return jax.lax.psum(outputs * mask, axis_name)
+    out = jax.lax.psum(outputs * mask, axis_name)
+    if not with_aux:
+        return out
+    # Sum stage-local aux across stages; average over microbatches so the
+    # scale matches the non-pp full-batch aux.
+    aux = jax.lax.psum(aux_acc, axis_name) / num_mb
+    return out, aux

@@ -118,9 +118,18 @@ class ServeController:
                 for name, st in self._deployments.items()}
 
     def shutdown_serve(self) -> None:
+        """Full teardown: kill replicas SYNCHRONOUSLY — drain threads
+        would die with the controller process, leaking replicas."""
         self._running = False
-        for name in list(self._deployments):
-            self.delete_deployment(name)
+        with self._lock:
+            for name in list(self._deployments):
+                st = self._deployments.pop(name)
+                for r in st.replicas:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+            self._version += 1
 
     # -- reconciliation -------------------------------------------------
     def _make_replica(self, st: _DeploymentState):
@@ -177,13 +186,26 @@ class ServeController:
 
     @staticmethod
     def _probe(replicas: List[Any], method: str) -> Dict[bytes, Any]:
-        out: Dict[bytes, Any] = {}
+        """Probe all replicas CONCURRENTLY (submit everything, then
+        collect against one shared deadline) — one hung replica must not
+        serialize the whole control loop at 10s per probe."""
+        refs = {}
         for r in replicas:
             try:
-                out[r.actor_id.binary()] = ray_tpu.get(
-                    getattr(r, method).remote(), timeout=10)
+                refs[r.actor_id.binary()] = getattr(r, method).remote()
             except Exception:
-                out[r.actor_id.binary()] = None
+                refs[r.actor_id.binary()] = None
+        out: Dict[bytes, Any] = {}
+        deadline = time.monotonic() + 10.0
+        for aid, ref in refs.items():
+            if ref is None:
+                out[aid] = None
+                continue
+            try:
+                out[aid] = ray_tpu.get(
+                    ref, timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:
+                out[aid] = None
         return out
 
     # Replicas doing heavy init (model load + XLA compile) must not be

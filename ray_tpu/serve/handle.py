@@ -22,13 +22,24 @@ import ray_tpu
 
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference: serve/handle.py
-    DeploymentResponse)."""
+    DeploymentResponse). Replica death surfaces here (actor submission is
+    async), so result() re-routes the request once through the router."""
 
-    def __init__(self, ref):
+    def __init__(self, ref, retry=None):
         self._ref = ref
+        self._retry = retry
 
     def result(self, timeout: Optional[float] = None):
-        return ray_tpu.get(self._ref, timeout=timeout)
+        from ray_tpu.core.common import (ActorDiedError, ObjectLostError,
+                                         WorkerCrashedError)
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        except (ActorDiedError, WorkerCrashedError, ObjectLostError):
+            if self._retry is None:
+                raise
+            self._ref = self._retry()
+            self._retry = None  # one re-route per request
+            return ray_tpu.get(self._ref, timeout=timeout)
 
     @property
     def ref(self):
@@ -117,17 +128,17 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         blob = cloudpickle.dumps((args, kwargs))
-        last_exc: Optional[Exception] = None
-        for _ in range(3):  # retry across replica failures
+
+        def dispatch():
             replica = self._router.choose_replica()
-            try:
-                ref = replica.handle_request.remote(self._method, blob)
-                return DeploymentResponse(ref)
-            except Exception as e:
-                last_exc = e
-                self._router.on_replica_error()
-        raise RuntimeError(
-            f"could not route request to {self._deployment!r}: {last_exc!r}")
+            return replica.handle_request.remote(self._method, blob)
+
+        def re_route():
+            # Replica died after dispatch: refresh the table and resend.
+            self._router.on_replica_error()
+            return dispatch()
+
+        return DeploymentResponse(dispatch(), retry=re_route)
 
     def stream(self, *args, **kwargs):
         """Streaming call: the deployment method must be a generator;

@@ -62,10 +62,14 @@ class HttpProxy:
     def _refresh_routes(self) -> None:
         table = ray_tpu.get(self._controller.list_deployments.remote(),
                             timeout=10)
-        self._routes = {}
+        # Build fully, assign once: this runs off-loop while the event
+        # loop reads self._routes (in-place clearing would 404 live
+        # routes mid-refresh).
+        routes = {}
         for name, info in table.items():
             prefix = info["config"].get("route_prefix") or f"/{name}"
-            self._routes[prefix] = name
+            routes[prefix] = name
+        self._routes = routes
 
     def _match(self, path: str) -> Optional[str]:
         # Longest-prefix match (reference: proxy route resolution).
@@ -127,8 +131,11 @@ class HttpProxy:
                 break
             k, _, v = h.decode().partition(":")
             headers[k.strip().lower()] = v.strip()
-        length = int(headers.get("content-length", 0))
-        body = await reader.readexactly(length) if length else b""
+        try:
+            length = int(headers.get("content-length", 0))
+        except ValueError:
+            return None  # malformed header: drop the connection politely
+        body = await reader.readexactly(length) if length > 0 else b""
         return method, path, headers, body
 
     @staticmethod
@@ -189,8 +196,14 @@ class HttpProxy:
                      b"Content-Type: text/plain\r\n"
                      b"Transfer-Encoding: chunked\r\n\r\n")
         await writer.drain()
-        q: asyncio.Queue = asyncio.Queue()
+        # Bounded: a fast producer must not buffer an entire generation
+        # for a slow client (the pump blocks on put until the writer
+        # drains).
+        q: asyncio.Queue = asyncio.Queue(maxsize=16)
         gone = threading.Event()  # client disconnected: stop the producer
+
+        def put_blocking(msg) -> None:
+            asyncio.run_coroutine_threadsafe(q.put(msg), loop).result(60)
 
         def pump():
             try:
@@ -201,11 +214,17 @@ class HttpProxy:
                         if close:
                             close()  # releases the replica-side stream
                         return
-                    loop.call_soon_threadsafe(q.put_nowait, ("item", item))
+                    put_blocking(("item", item))
             except BaseException as e:  # noqa: BLE001
-                loop.call_soon_threadsafe(q.put_nowait, ("err", repr(e)))
+                try:
+                    put_blocking(("err", repr(e)))
+                except Exception:
+                    pass
             finally:
-                loop.call_soon_threadsafe(q.put_nowait, ("end", None))
+                try:
+                    put_blocking(("end", None))
+                except Exception:
+                    pass
 
         threading.Thread(target=pump, daemon=True).start()
         try:
@@ -218,6 +237,8 @@ class HttpProxy:
                 else:
                     chunk = (item if isinstance(item, (bytes, bytearray))
                              else str(item).encode())
+                if not chunk:
+                    continue  # a 0-length chunk IS the stream terminator
                 writer.write(f"{len(chunk):x}\r\n".encode() + chunk
                              + b"\r\n")
                 await writer.drain()

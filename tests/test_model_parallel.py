@@ -37,11 +37,9 @@ def _tokens(cfg, bs=4, seq=64, seed=0):
 @pytest.mark.parametrize("name,mcfg,lcfg", CONFIGS,
                          ids=[c[0] for c in CONFIGS])
 def test_loss_parity_vs_single_device(devices8, name, mcfg, lcfg):
-    import dataclasses
-    if mcfg.pp > 1 and lcfg.n_experts > 0:
-        # Pipeline mode drops the MoE aux loss (single-tensor GPipe state);
-        # compare the CE part only until gpipe carries pytree state.
-        lcfg = dataclasses.replace(lcfg, moe_aux_weight=0.0)
+    # Note pp+MoE: gpipe carries the aux loss per microbatch (averaged),
+    # vs the reference's full-batch aux — a nonlinear statistic, so the
+    # values differ slightly; rtol below absorbs it.
     params = init_params(lcfg, jax.random.PRNGKey(0))
     toks = _tokens(lcfg)
     ref_loss, _ = jax.jit(

@@ -125,10 +125,64 @@ class TestMoE:
         w_up = jax.random.normal(ks[1], (1, d, f))
         w_gate = jax.random.normal(ks[2], (1, d, f))
         w_down = jax.random.normal(ks[3], (1, f, d))
-        out, aux = moe_ffn(x, gate_w, w_up, w_gate, w_down, top_k=1)
+        out, aux = moe_ffn(x, gate_w, w_up, w_gate, w_down, top_k=1,
+                           capacity_factor=2.0)
         dense = jax.nn.silu(x @ w_gate[0]) * (x @ w_up[0]) @ w_down[0]
         np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
                                    atol=1e-5)
+
+    def test_capacity_dispatch_matches_reference_combine(self):
+        """With capacity high enough that nothing drops, the gather/scatter
+        dispatch must equal the straightforward dense-combine computation."""
+        key = jax.random.PRNGKey(1)
+        t, d, f, e, k = 16, 8, 12, 4, 2
+        ks = jax.random.split(key, 5)
+        x = jax.random.normal(ks[0], (t, d))
+        gate_w = jax.random.normal(ks[4], (d, e))
+        w_up = jax.random.normal(ks[1], (e, d, f))
+        w_gate = jax.random.normal(ks[2], (e, d, f))
+        w_down = jax.random.normal(ks[3], (e, f, d))
+        out, aux = moe_ffn(x, gate_w, w_up, w_gate, w_down, top_k=k,
+                           capacity_factor=float(e))  # no drops possible
+
+        # Reference: dense every-expert-sees-every-token combine.
+        logits = x @ gate_w
+        weights, idx = top_k_routing(logits, k)
+        one_hot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        combine = jnp.einsum("tk,tke->te", weights, one_hot)
+        h = jax.nn.silu(jnp.einsum("td,edf->etf", x, w_gate)) * \
+            jnp.einsum("td,edf->etf", x, w_up)
+        expert_out = jnp.einsum("etf,efd->etd", h, w_down)
+        dense = jnp.einsum("etd,te->td", expert_out, combine)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_capacity_dispatch_drops_overflow(self):
+        """Tokens past an expert's capacity contribute zero (Switch
+        semantics) — and the op still differentiates."""
+        t, d, f, e = 8, 4, 8, 2
+        key = jax.random.PRNGKey(2)
+        ks = jax.random.split(key, 4)
+        x = jax.random.normal(ks[0], (t, d))
+        # Zero router logits: top_k tie-breaks to expert 0 for EVERY token.
+        gate_w = jnp.zeros((d, e))
+        w_up = jax.random.normal(ks[1], (e, d, f))
+        w_gate = jax.random.normal(ks[2], (e, d, f))
+        w_down = jax.random.normal(ks[3], (e, f, d))
+        # capacity = ceil(8*1*0.5/2) = 2: only 2 of 8 tokens survive.
+        out, _ = moe_ffn(x, gate_w, w_up, w_gate, w_down, top_k=1,
+                         capacity_factor=0.5)
+        nonzero_rows = np.flatnonzero(
+            np.abs(np.asarray(out)).sum(axis=-1) > 1e-7)
+        assert len(nonzero_rows) == 2, nonzero_rows
+
+        def loss(xx):
+            o, aux = moe_ffn(xx, gate_w, w_up, w_gate, w_down, top_k=1,
+                             capacity_factor=0.5)
+            return jnp.sum(o ** 2) + aux
+
+        g = jax.grad(loss)(x)
+        assert np.isfinite(np.asarray(g)).all()
 
 
 def test_flash_attention_pallas_backward_tpu():
