@@ -148,9 +148,12 @@ class CoreWorker:
         # Cancellation: task_ids cancelled by the user; where tasks execute.
         self._cancelled: set = set()
         self._task_exec_addr: Dict[bytes, Address] = {}
-        # Worker-side cancellation: task_ids to skip/interrupt.
+        # Worker-side cancellation: task_ids to skip/interrupt, plus the
+        # thread currently executing each task (async actors run several
+        # tasks on different threads concurrently — cancel must target
+        # the RIGHT thread).
         self._exec_cancelled: set = set()
-        self._exec_current: Optional[bytes] = None
+        self._exec_threads: Dict[bytes, int] = {}
         # Lease-cached dispatch state, per scheduling class.
         self._class_queues: Dict[tuple, list] = {}
         self._class_pumps: Dict[tuple, asyncio.Task] = {}
@@ -1262,13 +1265,14 @@ class CoreWorker:
         if force:
             os._exit(1)
         self._exec_cancelled.add(task_id)
-        if self._exec_current == task_id and self._exec_thread_id is not None:
+        tid = self._exec_threads.get(task_id)
+        if tid is not None:
             import ctypes
             from ray_tpu.core.common import TaskCancelledError
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                ctypes.c_ulong(self._exec_thread_id),
+                ctypes.c_ulong(tid),
                 ctypes.py_object(TaskCancelledError))
-            return True  # interrupted the running task
+            return True  # interrupted the running task's own thread
         return False  # queued/unknown: the exec-entry flag check handles it
 
     @long_poll
@@ -1346,14 +1350,14 @@ class CoreWorker:
                 func = await self._load_function(spec.func_id)
                 user_fn = lambda: func(*args, **kwargs)  # noqa: E731
 
-            # _exec_current must be set by the EXEC THREAD itself: with
+            # The task->thread registration is made by the EXEC THREAD itself: with
             # pipelined dispatch several _execute coroutines are alive at
             # once and a coroutine-side marker would track the wrong task
             # (cancel would then interrupt an unrelated task). The cancel
             # flag is re-checked here too — a cancel can land while the
             # task is parked in the exec pool behind another task.
             def fn():
-                self._exec_current = spec.task_id
+                self._exec_threads[spec.task_id] = threading.get_ident()
                 try:
                     if spec.task_id in self._exec_cancelled:
                         from ray_tpu.core.common import TaskCancelledError
@@ -1361,7 +1365,7 @@ class CoreWorker:
                             f"task {spec.name} cancelled")
                     return user_fn()
                 finally:
-                    self._exec_current = None
+                    self._exec_threads.pop(spec.task_id, None)
 
             if spec.streaming:
                 return await self._execute_streaming(spec, user_fn)
@@ -1441,7 +1445,7 @@ class CoreWorker:
 
         def run_gen() -> int:
             from collections import deque
-            self._exec_current = spec.task_id
+            self._exec_threads[spec.task_id] = threading.get_ident()
             try:
                 if spec.task_id in self._exec_cancelled:
                     raise TaskCancelledError(f"task {spec.name} cancelled")
@@ -1474,7 +1478,7 @@ class CoreWorker:
                     pending.popleft().result()
                 return count
             finally:
-                self._exec_current = None
+                self._exec_threads.pop(spec.task_id, None)
 
         try:
             # Async actors stream CONCURRENTLY (default thread pool): a

@@ -60,9 +60,11 @@ def moe_ffn(x: jax.Array, gate_w: jax.Array, w_up: jax.Array, w_gate: jax.Array,
     flat_expert = idx.reshape(-1)                        # [t*k]
     flat_weight = weights.reshape(-1)                    # [t*k]
     flat_token = jnp.repeat(jnp.arange(tokens), top_k)   # [t*k]
-    flat_oh = one_hot.reshape(tokens * top_k, n_experts)
-    pos_in_expert = (jnp.cumsum(flat_oh, axis=0) - flat_oh)  # [t*k, e]
-    pos = jnp.sum(pos_in_expert * flat_oh, axis=-1).astype(jnp.int32)
+    # int32 cumsum: float32 counting loses exactness past 2^24 assignments
+    # (slot collisions would silently corrupt dispatch at large batches).
+    flat_oh_i = one_hot.reshape(tokens * top_k, n_experts).astype(jnp.int32)
+    pos_in_expert = jnp.cumsum(flat_oh_i, axis=0) - flat_oh_i  # [t*k, e]
+    pos = jnp.sum(pos_in_expert * flat_oh_i, axis=-1).astype(jnp.int32)
     keep = pos < capacity
     # Overflow assignments land in a trash slot past the real buffer.
     slot = jnp.where(keep, flat_expert * capacity + pos,

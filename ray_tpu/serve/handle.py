@@ -130,8 +130,20 @@ class DeploymentHandle:
         blob = cloudpickle.dumps((args, kwargs))
 
         def dispatch():
-            replica = self._router.choose_replica()
-            return replica.handle_request.remote(self._method, blob)
+            # Synchronous submission failures (stale table, dead handle)
+            # refresh the router and retry a couple of times; deaths that
+            # surface later are covered by the result()-side re-route.
+            last: Optional[Exception] = None
+            for _ in range(3):
+                try:
+                    replica = self._router.choose_replica()
+                    return replica.handle_request.remote(self._method, blob)
+                except Exception as e:
+                    last = e
+                    self._router.on_replica_error()
+            raise RuntimeError(
+                f"could not route request to {self._deployment!r}: "
+                f"{last!r}")
 
         def re_route():
             # Replica died after dispatch: refresh the table and resend.

@@ -202,25 +202,43 @@ class HttpProxy:
         q: asyncio.Queue = asyncio.Queue(maxsize=16)
         gone = threading.Event()  # client disconnected: stop the producer
 
+        class _ClientGone(Exception):
+            pass
+
         def put_blocking(msg) -> None:
-            asyncio.run_coroutine_threadsafe(q.put(msg), loop).result(60)
+            # Short waits + gone polling: after a disconnect nobody drains
+            # the queue, and a blind long block would pin this thread (and
+            # the replica-side stream) for minutes.
+            while True:
+                if gone.is_set():
+                    raise _ClientGone()
+                fut = asyncio.run_coroutine_threadsafe(q.put(msg), loop)
+                try:
+                    fut.result(0.5)
+                    return
+                except TimeoutError:
+                    if not fut.cancel() and fut.exception() is None:
+                        return  # the put landed right after the timeout
 
         def pump():
+            it = None
             try:
                 it = handle.stream(payload)
                 for item in it:
                     if gone.is_set():
-                        close = getattr(it, "close", None)
-                        if close:
-                            close()  # releases the replica-side stream
-                        return
+                        raise _ClientGone()
                     put_blocking(("item", item))
+            except _ClientGone:
+                pass
             except BaseException as e:  # noqa: BLE001
                 try:
                     put_blocking(("err", repr(e)))
                 except Exception:
                     pass
             finally:
+                close = getattr(it, "close", None)
+                if close:
+                    close()  # releases the replica-side stream
                 try:
                     put_blocking(("end", None))
                 except Exception:

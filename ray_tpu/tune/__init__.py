@@ -1,0 +1,32 @@
+"""ray_tpu.tune — hyperparameter search over the actor runtime.
+
+Analogue of Ray Tune (reference: python/ray/tune/ — Tuner, TuneController
+execution/tune_controller.py:68, search spaces search/sample.py, ASHA
+schedulers/async_hyperband.py), minimum slice: function trainables report
+per-iteration metrics; the controller runs trials as actors up to a
+concurrency cap; ASHA stops under-performers at rungs.
+
+    from ray_tpu import tune
+
+    def objective(config):
+        for _ in range(20):
+            tune.report({"loss": (config["x"] - 3) ** 2})
+
+    grid = tune.Tuner(objective,
+                      param_space={"x": tune.uniform(0, 5)},
+                      tune_config=tune.TuneConfig(metric="loss",
+                                                  num_samples=8)).fit()
+    best = grid.get_best_result()
+"""
+
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
+                                 uniform)
+from ray_tpu.tune.trial import report
+from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
+
+__all__ = [
+    "ASHAScheduler", "FIFOScheduler", "ResultGrid", "TrialResult",
+    "TuneConfig", "Tuner", "choice", "grid_search", "loguniform", "randint",
+    "report", "uniform",
+]

@@ -1,0 +1,63 @@
+"""Trial schedulers: FIFO + ASHA.
+
+Analogue of the reference's schedulers (reference: python/ray/tune/
+schedulers/trial_scheduler.py FIFOScheduler, async_hyperband.py
+AsyncHyperBandScheduler/ASHAScheduler — rungs at reduction_factor
+intervals; a trial reaching a rung survives only if it is in the top
+1/reduction_factor of results recorded at that rung).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, iteration: int,
+                  metric_value: float) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Asynchronous Successive Halving (reference:
+    schedulers/async_hyperband.py:29)."""
+
+    def __init__(self, *, max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3, metric: Optional[str] = None,
+                 mode: str = "min"):
+        assert mode in ("min", "max")
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.metric = metric  # default: the tuner's metric
+        self.mode = mode
+        # Rung milestones: grace, grace*rf, grace*rf^2, ... <= max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung milestone -> recorded metric values
+        self._recorded: Dict[int, List[float]] = {r: [] for r in self.rungs}
+
+    def on_result(self, trial_id: str, iteration: int,
+                  metric_value: float) -> str:
+        if iteration >= self.max_t:
+            return STOP  # budget exhausted (not a failure)
+        for rung in reversed(self.rungs):
+            if iteration == rung:
+                vals = self._recorded[rung]
+                vals.append(metric_value)
+                if len(vals) < self.rf:
+                    return CONTINUE  # not enough peers yet: optimistic
+                ranked = sorted(vals)
+                if self.mode == "max":
+                    ranked = ranked[::-1]
+                cutoff = ranked[max(0, len(vals) // self.rf - 1)]
+                good = metric_value <= cutoff if self.mode == "min" \
+                    else metric_value >= cutoff
+                return CONTINUE if good else STOP
+        return CONTINUE

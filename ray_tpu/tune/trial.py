@@ -1,0 +1,89 @@
+"""Trial state + the TrialRunner actor.
+
+Analogue of the reference's trial execution (reference: python/ray/tune/
+experiment/trial.py Trial states, tune/trainable/function_trainable.py —
+the user function runs in a thread and reports through a session). One
+TrialRunner actor per trial; the controller polls it like the Train
+controller polls its workers.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+PENDING, RUNNING, TERMINATED, ERROR, STOPPED = (
+    "PENDING", "RUNNING", "TERMINATED", "ERROR", "STOPPED")
+
+
+class _TuneSession:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.reported: List[Dict[str, Any]] = []
+        self.iteration = 0
+        self.stop_requested = False
+        self.finished = False
+        self.error: Optional[str] = None
+
+
+_session: Optional[_TuneSession] = None
+
+
+def report(metrics: Dict[str, Any]) -> None:
+    """Report one iteration's metrics from inside a trainable (reference:
+    ray.tune.report). Raises StopIteration-like exit when the scheduler
+    stopped this trial."""
+    if _session is None:
+        raise RuntimeError("tune.report() called outside a trial")
+    with _session.lock:
+        _session.iteration += 1
+        _session.reported.append(dict(metrics))
+        if _session.stop_requested:
+            raise _TrialStopped()
+
+
+class _TrialStopped(BaseException):
+    """Control-flow exception: scheduler stopped the trial (not an error)."""
+
+
+class TrialRunner:
+    """Actor hosting one trial's trainable function."""
+
+    def __init__(self, fn_blob: bytes, config: dict):
+        global _session
+        self._session = _TuneSession()
+        _session = self._session
+        fn = cloudpickle.loads(fn_blob)
+
+        def run():
+            try:
+                fn(config)
+            except _TrialStopped:
+                pass
+            except BaseException:
+                self._session.error = traceback.format_exc()
+            finally:
+                self._session.finished = True
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="trial")
+        self._thread.start()
+
+    def poll(self) -> dict:
+        s = self._session
+        with s.lock:
+            reported = s.reported
+            s.reported = []
+            return {
+                "reported": reported,
+                "iteration": s.iteration,
+                "finished": s.finished,
+                "error": s.error,
+            }
+
+    def stop_trial(self) -> None:
+        with self._session.lock:
+            self._session.stop_requested = True

@@ -1,0 +1,171 @@
+"""Tuner + TuneController — the experiment driver.
+
+Analogue of the reference's Tuner/TuneController (reference:
+python/ray/tune/tuner.py Tuner, tune/execution/tune_controller.py:68 —
+manage trial actors up to a concurrency cap, feed results to the
+scheduler, collect a ResultGrid).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import generate_variants
+from ray_tpu.tune.trial import (ERROR, PENDING, RUNNING, STOPPED,
+                                TERMINATED, TrialRunner)
+from ray_tpu.utils import get_logger
+
+logger = get_logger("tune")
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "min"                 # or "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None             # FIFOScheduler | ASHAScheduler
+    seed: Optional[int] = None
+    resources_per_trial: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any] = field(default_factory=dict)  # last report
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    status: str = PENDING
+    error: Optional[str] = None
+    iterations: int = 0
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __len__(self):
+        return len(self._results)
+
+    @property
+    def results(self) -> List[TrialResult]:
+        return list(self._results)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        assert metric, "a metric is required to rank results"
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError("no trial reported the metric "
+                             f"{metric!r}")
+        return (min if mode == "min" else max)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def num_errors(self) -> int:
+        return sum(1 for r in self._results if r.status == ERROR)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable[[dict], Any], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None):
+        self._fn_blob = cloudpickle.dumps(trainable)
+        self._space = param_space or {}
+        self._cfg = tune_config or TuneConfig()
+
+    def fit(self) -> ResultGrid:
+        cfg = self._cfg
+        scheduler = cfg.scheduler or FIFOScheduler()
+        if getattr(scheduler, "metric", "x") is None:
+            scheduler.metric = cfg.metric
+        variants = list(generate_variants(self._space, cfg.num_samples,
+                                          cfg.seed))
+        trials = [TrialResult(trial_id=f"trial_{i:05d}", config=v)
+                  for i, v in enumerate(variants)]
+        pending = list(trials)
+        running: Dict[str, Any] = {}   # trial_id -> actor handle
+        stopping: set = set()
+        actor_cls = ray_tpu.remote(TrialRunner)
+        opts: Dict[str, Any] = {}
+        if cfg.resources_per_trial:
+            res = dict(cfg.resources_per_trial)
+            if "CPU" in res:
+                opts["num_cpus"] = res.pop("CPU")
+            if "TPU" in res:
+                opts["num_tpus"] = res.pop("TPU")
+            if res:
+                opts["resources"] = res
+        if opts:
+            actor_cls = actor_cls.options(**opts)
+
+        by_id = {t.trial_id: t for t in trials}
+        while pending or running:
+            while pending and len(running) < cfg.max_concurrent_trials:
+                t = pending.pop(0)
+                t.status = RUNNING
+                running[t.trial_id] = actor_cls.remote(self._fn_blob,
+                                                       t.config)
+            done: List[str] = []
+            for tid, actor in running.items():
+                t = by_id[tid]
+                try:
+                    p = ray_tpu.get(actor.poll.remote(), timeout=60)
+                except Exception as e:
+                    t.status = ERROR
+                    t.error = f"trial actor died: {e!r}"
+                    done.append(tid)
+                    continue
+                for m in p["reported"]:
+                    t.metrics_history.append(m)
+                    t.metrics = m
+                t.iterations = p["iteration"]
+                metric = cfg.metric
+                if metric and p["reported"] and tid not in stopping:
+                    decision = CONTINUE
+                    for i, m in enumerate(p["reported"]):
+                        if metric in m:
+                            it = (t.iterations - len(p["reported"]) + 1
+                                  + i)
+                            decision = scheduler.on_result(
+                                tid, it, float(m[metric]))
+                            if decision == STOP:
+                                break
+                    if decision == STOP:
+                        stopping.add(tid)
+                        try:
+                            actor.stop_trial.remote()
+                        except Exception:
+                            pass
+                if p["finished"]:
+                    if p["error"]:
+                        t.status = ERROR
+                        t.error = p["error"]
+                    else:
+                        t.status = STOPPED if tid in stopping \
+                            else TERMINATED
+                    done.append(tid)
+            for tid in done:
+                actor = running.pop(tid)
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+            if running:
+                time.sleep(0.2)
+        logger.info("tune finished: %d trials (%d errors)", len(trials),
+                    sum(1 for t in trials if t.status == ERROR))
+        return ResultGrid(trials, cfg.metric, cfg.mode)
