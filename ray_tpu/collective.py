@@ -1,0 +1,207 @@
+"""Explicit collective groups over actor sets.
+
+Analogue of the reference's collective API (reference:
+python/ray/util/collective/collective.py init_collective_group:166 /
+allreduce:311 / broadcast:426 / allgather:476 / barrier:351, with NCCL
+rendezvous via a named actor, nccl_collective_group.py:28). TPU-native
+mapping (SURVEY §2.3): groups whose workers run under one
+``jax.distributed`` mesh should use XLA/ICI collectives compiled into
+their programs (psum et al. — the train path); THIS module is the
+out-of-band fallback plane (the reference's gloo analogue,
+``collective_cpu_fallback``): a coordinator actor is the rendezvous AND
+the reduction point — each rank's contribute() long-polls until every
+rank arrived, so one actor-call round trip completes the collective.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.utils.config import GlobalConfig
+
+_REDUCERS = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "mean": lambda arrs: np.mean(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+}
+
+
+class _Coordinator:
+    """Rendezvous + reduction actor (async: each contribute long-polls)."""
+
+    def __init__(self, world: int):
+        self._world = world
+        self._pending: Dict[tuple, dict] = {}  # (op_key, step) -> state
+
+    def _state(self, key) -> dict:
+        st = self._pending.get(key)
+        if st is None:
+            st = self._pending[key] = {
+                "parts": {}, "event": asyncio.Event(), "result": None}
+        return st
+
+    async def contribute(self, op: str, name: str, step: int, rank: int,
+                         payload, reduce_op: str = "sum",
+                         src_rank: int = 0):
+        key = (op, name, step)
+        st = self._state(key)
+        st["parts"][rank] = payload
+        if len(st["parts"]) == self._world:
+            parts = st["parts"]
+            try:
+                if op == "allreduce":
+                    arrs = [np.asarray(parts[r])
+                            for r in range(self._world)]
+                    st["result"] = _REDUCERS[reduce_op](arrs)
+                elif op == "allgather":
+                    st["result"] = [parts[r] for r in range(self._world)]
+                elif op == "broadcast":
+                    st["result"] = parts[src_rank]
+                elif op == "barrier":
+                    st["result"] = True
+                else:
+                    raise ValueError(f"unknown collective op {op!r}")
+            except BaseException as e:  # noqa: BLE001
+                # The error must reach EVERY rank — leaving the event
+                # unset would hang world-1 ranks until their timeouts.
+                st["error"] = e
+            st["event"].set()
+        else:
+            await st["event"].wait()
+        err = st.get("error")
+        result = st["result"]
+        # Last reader cleans up (every rank reads exactly once).
+        st["readers"] = st.get("readers", 0) + 1
+        if st["readers"] == self._world:
+            self._pending.pop(key, None)
+        if err is not None:
+            raise RuntimeError(f"collective {op!r} failed: {err!r}")
+        return result
+
+
+class _GroupInfo:
+    def __init__(self, coordinator, rank: int, world: int):
+        self.coordinator = coordinator
+        self.rank = rank
+        self.world = world
+        self.step = 0
+
+
+_groups: Dict[str, _GroupInfo] = {}
+
+
+def _declare_group(group_name: str, coordinator, rank: int,
+                   world: int) -> None:
+    """Called inside each member actor (via init_collective_group)."""
+    _groups[group_name] = _GroupInfo(coordinator, rank, world)
+
+
+def init_collective_group(actors: List[Any],
+                          group_name: str = "default") -> None:
+    """Driver-side setup: create the coordinator, tell every member actor
+    its rank (reference: collective.py:203 create_collective_group —
+    declare_collective_group on each actor)."""
+    if not GlobalConfig.collective_cpu_fallback:
+        raise RuntimeError(
+            "out-of-band collectives disabled "
+            "(collective_cpu_fallback=False); use XLA collectives inside "
+            "a jax.distributed group instead")
+    world = len(actors)
+    coordinator = ray_tpu.remote(_Coordinator).remote(world)
+    ray_tpu.get([
+        a.declare_collective_group.remote(group_name, coordinator, rank,
+                                          world)
+        for rank, a in enumerate(actors)], timeout=120)
+
+
+class CollectiveMixin:
+    """Mix into an actor class to make it collective-group-capable
+    (provides the declare_collective_group method init_collective_group
+    calls on every member)."""
+
+    def declare_collective_group(self, group_name, coordinator, rank,
+                                 world):
+        _declare_group(group_name, coordinator, rank, world)
+        return rank
+
+
+def _group(group_name: str) -> _GroupInfo:
+    g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not declared in this process")
+    return g
+
+
+def _to_host(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def _like(result: np.ndarray, tensor):
+    try:
+        import jax
+        if isinstance(tensor, jax.Array):
+            import jax.numpy as jnp
+            return jnp.asarray(result)
+    except Exception:
+        pass
+    return result
+
+
+def _call(g: _GroupInfo, op: str, name: str, payload, **kw):
+    g.step += 1
+    return ray_tpu.get(g.coordinator.contribute.remote(
+        op, name, g.step, g.rank, payload, **kw), timeout=600)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    """Reduce across the group; returns the reduced tensor (same type in
+    -> out for jax arrays; device transfer is the host hop of the
+    fallback plane)."""
+    g = _group(group_name)
+    out = _call(g, "allreduce", group_name, _to_host(tensor), reduce_op=op)
+    return _like(out, tensor)
+
+
+def allgather(tensor, group_name: str = "default") -> List[Any]:
+    g = _group(group_name)
+    outs = _call(g, "allgather", group_name, _to_host(tensor))
+    return [_like(o, tensor) for o in outs]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _group(group_name)
+    out = _call(g, "broadcast", group_name, _to_host(tensor),
+                src_rank=src_rank)
+    return _like(out, tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    """Reduce then return this rank's equal slice along axis 0."""
+    g = _group(group_name)
+    out = np.asarray(_call(g, "allreduce", group_name, _to_host(tensor),
+                           reduce_op=op))
+    if out.shape[0] % g.world != 0:
+        raise ValueError(
+            f"reducescatter needs dim0 ({out.shape[0]}) divisible by the "
+            f"group size ({g.world})")
+    n = out.shape[0] // g.world
+    return _like(out[g.rank * n:(g.rank + 1) * n], tensor)
+
+
+def barrier(group_name: str = "default") -> None:
+    g = _group(group_name)
+    _call(g, "barrier", group_name, None)

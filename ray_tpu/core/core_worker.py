@@ -118,10 +118,8 @@ class CoreWorker:
         self._actor_seqno: Dict[bytes, int] = {}
         self._actor_waiters: Dict[bytes, Dict[int, asyncio.Event]] = {}
         self._is_actor_worker = False
-        self._exec_thread_id: Optional[int] = None
         self._exec_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="task-exec",
-            initializer=self._record_exec_thread)
+            max_workers=1, thread_name_prefix="task-exec")
         self._worker_clients: Dict[Address, RpcClient] = {}
         # actor_id -> (addr, client, incarnation)
         self._actor_clients: Dict[bytes, Tuple[Address, RpcClient, int]] = {}
@@ -154,6 +152,8 @@ class CoreWorker:
         # the RIGHT thread).
         self._exec_cancelled: set = set()
         self._exec_threads: Dict[bytes, int] = {}
+        # Device-resident objects (RDT): key -> jax array kept in HBM.
+        self._device_objects: Dict[bytes, Any] = {}
         # Lease-cached dispatch state, per scheduling class.
         self._class_queues: Dict[tuple, list] = {}
         self._class_pumps: Dict[tuple, asyncio.Task] = {}
@@ -376,6 +376,38 @@ class CoreWorker:
 
     async def ping(self) -> str:
         return "pong"
+
+    # ------------------------------------------------------------------
+    # device-resident objects (reference: experimental/gpu_object_manager/
+    # gpu_object_manager.py:61 — ObjectRef metadata travels the control
+    # plane while the tensor stays in device memory; transfer happens
+    # out-of-band on fetch)
+    # ------------------------------------------------------------------
+    def put_device_object(self, key: bytes, array: Any) -> None:
+        self._device_objects[key] = array
+
+    def get_device_object_local(self, key: bytes) -> Any:
+        return self._device_objects.get(key)
+
+    def free_device_object(self, key: bytes) -> None:
+        self._device_objects.pop(key, None)
+
+    async def fetch_device_object(self, key: bytes) -> Optional[tuple]:
+        """Out-of-band transfer endpoint: device -> host array -> wire
+        (pickle-5 ships the buffer without an extra copy). The D2H copy
+        runs OFF the io loop — a multi-GB transfer must not stall this
+        worker's RPC service. (Intra-slice ICI transfer without the host
+        hop is the planned fast path via the jax transfer server.)"""
+        arr = self._device_objects.get(key)
+        if arr is None:
+            return None
+        import numpy as np
+        host = await asyncio.get_running_loop().run_in_executor(
+            None, np.asarray, arr)
+        return (host, str(host.dtype), host.shape)
+
+    async def free_device_object_remote(self, key: bytes) -> None:
+        self.free_device_object(key)
 
     # ------------------------------------------------------------------
     # streaming generators (owner side; reference: task_manager.cc
@@ -1253,9 +1285,6 @@ class CoreWorker:
             if not m.startswith("__") or m == "__call__")
         self._actor_sem = asyncio.Semaphore(
             int(creation.get("max_concurrency") or 1000))
-
-    def _record_exec_thread(self) -> None:
-        self._exec_thread_id = threading.get_ident()
 
     async def cancel_task(self, task_id: bytes, force: bool = False) -> bool:
         """Cancel an incoming/running task on THIS worker (reference:

@@ -1,0 +1,95 @@
+"""Device-resident object refs (RDT) — tensors that stay in HBM.
+
+Analogue of the reference's Ray Direct Transport (reference:
+python/ray/experimental/gpu_object_manager/gpu_object_manager.py:61
+GPUObjectManager — the ObjectRef travels the control plane, the tensor
+stays in device memory on its owner and moves out-of-band on demand).
+TPU-native shape:
+
+    ref = device_put_ref(jax_array)        # stays in this process's HBM
+    # ... ship `ref` through actor calls / task args (tiny metadata) ...
+    arr = device_get(ref)                  # owner->here transfer, then
+                                           # host->device onto local chips
+
+Transfer rides the core-worker RPC plane as host bytes (the DCN-equivalent
+path); intra-slice ICI device-to-device via the jax transfer server is the
+planned fast path. free_ref() drops the owner's HBM reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from ray_tpu.core.ref import get_core_worker
+
+
+class DeviceRef:
+    """Handle to an array resident on its owner process's devices."""
+
+    __slots__ = ("owner_addr", "key", "shape", "dtype")
+
+    def __init__(self, owner_addr, key: bytes, shape, dtype: str):
+        self.owner_addr = tuple(owner_addr)
+        self.key = key
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def __reduce__(self):
+        return (DeviceRef, (self.owner_addr, self.key, self.shape,
+                            self.dtype))
+
+    def __repr__(self):
+        return (f"DeviceRef({self.key.hex()[:8]}, shape={self.shape}, "
+                f"dtype={self.dtype}, owner={self.owner_addr})")
+
+
+def device_put_ref(array: Any) -> DeviceRef:
+    """Register a (jax) array as device-resident in THIS process; the
+    returned ref is cheap to pass around the cluster."""
+    cw = get_core_worker()
+    key = os.urandom(16)
+    cw.put_device_object(key, array)
+    return DeviceRef(cw.address, key, getattr(array, "shape", ()),
+                     str(getattr(array, "dtype", "float32")))
+
+
+def device_get(ref: DeviceRef, *, sharding: Optional[Any] = None,
+               timeout: float = 120.0) -> Any:
+    """Materialize the array locally. Same-process: zero-copy handle.
+    Remote: out-of-band fetch from the owner, then jax.device_put
+    (optionally with a target sharding)."""
+    import numpy as np
+
+    cw = get_core_worker()
+    if tuple(ref.owner_addr) == cw.address:
+        local = cw.get_device_object_local(ref.key)
+        if local is None:
+            raise KeyError(f"device object freed: {ref}")
+        return local
+    client = cw._client_for_worker(ref.owner_addr)
+    got = cw._run(client.call("fetch_device_object",
+                              ref.key)).result(timeout)
+    if got is None:
+        raise KeyError(f"device object freed on owner: {ref}")
+    data, dtype, shape = got
+    host = np.frombuffer(data, dtype=np.dtype(dtype)).reshape(shape)
+    try:
+        import jax
+        return jax.device_put(host, sharding) if sharding is not None \
+            else jax.device_put(host)
+    except Exception:
+        return host
+
+
+def free_ref(ref: DeviceRef) -> None:
+    """Drop the owner's HBM reference (idempotent)."""
+    cw = get_core_worker()
+    if tuple(ref.owner_addr) == cw.address:
+        cw.free_device_object(ref.key)
+        return
+    client = cw._client_for_worker(ref.owner_addr)
+    try:
+        cw._run(client.call("free_device_object_remote", ref.key)).result(30)
+    except Exception:
+        pass

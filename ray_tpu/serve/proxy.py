@@ -209,16 +209,18 @@ class HttpProxy:
             # Short waits + gone polling: after a disconnect nobody drains
             # the queue, and a blind long block would pin this thread (and
             # the replica-side stream) for minutes.
+            # Wait on ONE put future, polling gone between timeouts — a
+            # cancel-and-resubmit loop could land the same chunk twice
+            # when the cancel races a just-completed put.
+            fut = asyncio.run_coroutine_threadsafe(q.put(msg), loop)
             while True:
-                if gone.is_set():
-                    raise _ClientGone()
-                fut = asyncio.run_coroutine_threadsafe(q.put(msg), loop)
                 try:
                     fut.result(0.5)
                     return
                 except TimeoutError:
-                    if not fut.cancel() and fut.exception() is None:
-                        return  # the put landed right after the timeout
+                    if gone.is_set():
+                        fut.cancel()
+                        raise _ClientGone()
 
         def pump():
             it = None

@@ -133,7 +133,9 @@ class Tuner:
                     t.metrics_history.append(m)
                     t.metrics = m
                 t.iterations = p["iteration"]
-                metric = cfg.metric
+                # The scheduler may rank on its OWN metric (e.g. ASHA on
+                # accuracy while the tuner reports best-loss).
+                metric = getattr(scheduler, "metric", None) or cfg.metric
                 if metric and p["reported"] and tid not in stopping:
                     decision = CONTINUE
                     for i, m in enumerate(p["reported"]):
