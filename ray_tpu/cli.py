@@ -1,0 +1,158 @@
+"""CLI: cluster lifecycle + introspection.
+
+Analogue of the reference's CLI (reference: python/ray/scripts/scripts.py
+— `ray start/stop/status/list/timeline`, registrations at :2688-2749).
+
+    python -m ray_tpu.cli start --head [--resources '{"CPU": 8}']
+    python -m ray_tpu.cli start --address HOST:PORT      # join as a node
+    python -m ray_tpu.cli status --address HOST:PORT
+    python -m ray_tpu.cli list actors|nodes|tasks|workers --address ...
+    python -m ray_tpu.cli timeline --address ... --out trace.json
+    python -m ray_tpu.cli metrics --address ...
+    python -m ray_tpu.cli stop --address ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _connect(address: str) -> None:
+    import ray_tpu
+    ray_tpu.init(address=address)
+
+
+def cmd_start(args) -> int:
+    import ray_tpu
+    if args.head:
+        resources = json.loads(args.resources) if args.resources else None
+        info = ray_tpu.init(resources=resources)
+        host, port = info["controller_address"]
+        print(f"ray_tpu head started. Controller at {host}:{port}")
+        print(f"Join more nodes:  python -m ray_tpu.cli start "
+              f"--address {host}:{port}")
+        print(f"Connect a driver: ray_tpu.init(address=\"{host}:{port}\")")
+        if args.block:
+            import signal
+            print("--block: serving until interrupted.")
+            try:
+                signal.pause()
+            except KeyboardInterrupt:
+                pass
+            ray_tpu.shutdown()
+        else:
+            # Detach: the spawned controller/agent keep running.
+            import atexit
+
+            from ray_tpu import api as _api
+            if _api._global_node is not None:
+                atexit.unregister(_api._global_node.stop)
+        return 0
+    if not args.address:
+        print("start needs --head or --address", file=sys.stderr)
+        return 2
+    host, port_s = args.address.rsplit(":", 1)
+    from ray_tpu.core.node import make_session_dir, start_agent
+    resources = json.loads(args.resources) if args.resources else {}
+    proc, port = start_agent((host, int(port_s)), make_session_dir(),
+                             resources or None)
+    print(f"node agent joined {args.address} (agent port {port})")
+    if args.block:
+        proc.wait()
+    return 0
+
+
+def cmd_status(args) -> int:
+    _connect(args.address)
+    from ray_tpu import state
+    s = state.cluster_summary()
+    print(f"nodes: {s['nodes_alive']}/{s['nodes_total']} alive; "
+          f"actors: {s['actors']}")
+    print("resources:")
+    for k, total in sorted(s["resources_total"].items()):
+        avail = s["resources_available"].get(k, 0)
+        print(f"  {k}: {avail:g}/{total:g} available")
+    return 0
+
+
+def cmd_list(args) -> int:
+    _connect(args.address)
+    from ray_tpu import state
+    kind = args.kind
+    rows = {"actors": state.list_actors, "nodes": state.list_nodes,
+            "tasks": state.list_tasks, "workers": state.list_workers}[kind]()
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    _connect(args.address)
+    from ray_tpu import state
+    trace = state.timeline(args.out)
+    print(f"wrote {len(trace)} trace events to {args.out}")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    _connect(args.address)
+    from ray_tpu import state
+    print(state.metrics_text())
+    return 0
+
+
+def cmd_stop(args) -> int:
+    _connect(args.address)
+    from ray_tpu import api as _api
+    cw = _api._cw()
+    for n in cw._run(cw.controller.call("get_nodes")).result(30):
+        if n["state"] != "ALIVE":
+            continue
+        try:
+            cw._run(cw._client_for_worker(
+                tuple(n["addr"])).call("shutdown_node")).result(10)
+        except Exception:
+            pass
+    try:
+        cw._run(cw.controller.call("shutdown_controller")).result(10)
+    except Exception:
+        pass
+    print("stop requested on all nodes + controller")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or join a cluster")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--resources", default="")
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    for name, fn in (("status", cmd_status), ("metrics", cmd_metrics),
+                     ("stop", cmd_stop)):
+        sp = sub.add_parser(name)
+        sp.add_argument("--address", required=True)
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list")
+    sp.add_argument("kind",
+                    choices=["actors", "nodes", "tasks", "workers"])
+    sp.add_argument("--address", required=True)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("timeline")
+    sp.add_argument("--address", required=True)
+    sp.add_argument("--out", default="timeline.json")
+    sp.set_defaults(fn=cmd_timeline)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,7 +72,15 @@ class _Coordinator:
                 st["error"] = e
             st["event"].set()
         else:
-            await st["event"].wait()
+            try:
+                # A rank that died before contributing must not wedge the
+                # group forever: time out, clean up, surface the failure.
+                await asyncio.wait_for(st["event"].wait(), 300.0)
+            except asyncio.TimeoutError:
+                self._pending.pop(key, None)
+                raise RuntimeError(
+                    f"collective {op!r} timed out: only "
+                    f"{len(st['parts'])}/{self._world} ranks arrived")
         err = st.get("error")
         result = st["result"]
         # Last reader cleans up (every rank reads exactly once).
@@ -184,8 +192,11 @@ def allgather(tensor, group_name: str = "default") -> List[Any]:
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _group(group_name)
-    out = _call(g, "broadcast", group_name, _to_host(tensor),
-                src_rank=src_rank)
+    # Only the source's payload matters: non-src ranks contribute None
+    # (the rendezvous key alone synchronizes them) — no point shipping
+    # world-1 full tensors that get discarded.
+    payload = _to_host(tensor) if g.rank == src_rank else None
+    out = _call(g, "broadcast", group_name, payload, src_rank=src_rank)
     return _like(out, tensor)
 
 

@@ -95,6 +95,55 @@ class Controller:
         #   actor_events — {"actor_id", "state", "addr", "death_reason"}
         #   log_events   — driver-facing error/log lines
         self.pubsub = PubsubHub()
+        # Observability sinks (reference: gcs_task_manager.cc task events
+        # + the metrics agent pipeline).
+        from collections import deque
+        self.task_events: "deque" = deque(maxlen=50000)
+        self.node_metrics: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------------
+    # observability (metrics + task events + timeline)
+    # ------------------------------------------------------------------
+    async def report_metrics(self, node_id: bytes, snapshot: dict) -> None:
+        self.node_metrics[node_id.hex()[:12]] = snapshot
+
+    async def get_metrics(self) -> dict:
+        return self.node_metrics
+
+    async def metrics_text(self) -> str:
+        """Prometheus text exposition over every node's registry."""
+        from ray_tpu.utils.metrics import render_prometheus
+        return render_prometheus(self.node_metrics)
+
+    async def report_task_events(self, events: list) -> None:
+        self.task_events.extend(events)
+
+    async def list_task_events(self, limit: int = 1000) -> list:
+        return list(self.task_events)[-limit:]
+
+    async def timeline(self) -> list:
+        """Chrome-trace events from the task ledger (reference:
+        `ray timeline`, _private/profiling.py chrome://tracing dump)."""
+        starts: Dict[str, dict] = {}
+        trace: list = []
+        for ev in self.task_events:
+            if ev["event"] == "submitted":
+                starts[ev["task_id"]] = ev
+            else:  # finished | failed
+                s = starts.pop(ev["task_id"], None)
+                if s is None:
+                    continue
+                trace.append({
+                    "name": ev.get("name", "task"),
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": s["ts"] * 1e6,
+                    "dur": max(0.0, (ev["ts"] - s["ts"]) * 1e6),
+                    "pid": ev.get("owner", "driver"),
+                    "tid": ev["task_id"][:8],
+                    "args": {"status": ev["event"]},
+                })
+        return trace
 
     # ------------------------------------------------------------------
     # pubsub
@@ -148,6 +197,7 @@ class Controller:
         if node is None or node.state == NodeState.DEAD:
             return
         node.state = NodeState.DEAD
+        self.node_metrics.pop(node_id.hex()[:12], None)  # stop reporting it
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         # Actors on the node die (and maybe restart).
         for actor in list(self.actors.values()):
@@ -529,6 +579,11 @@ class Controller:
 
     async def ping(self) -> str:
         return "pong"
+
+    async def shutdown_controller(self) -> None:
+        """Terminate the controller process (cli stop's final step)."""
+        import sys
+        asyncio.get_running_loop().call_later(0.2, sys.exit, 0)
 
     # ------------------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:

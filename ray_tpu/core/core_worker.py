@@ -154,6 +154,11 @@ class CoreWorker:
         self._exec_threads: Dict[bytes, int] = {}
         # Device-resident objects (RDT): key -> jax array kept in HBM.
         self._device_objects: Dict[bytes, Any] = {}
+        # Task-event buffer, flushed to the controller in batches
+        # (reference: task_event_buffer.cc -> gcs_task_manager.cc).
+        # Guarded: submit runs on user threads, completion on the io loop.
+        self._task_events: List[dict] = []
+        self._task_events_lock = threading.Lock()
         # Lease-cached dispatch state, per scheduling class.
         self._class_queues: Dict[tuple, list] = {}
         self._class_pumps: Dict[tuple, asyncio.Task] = {}
@@ -194,6 +199,7 @@ class CoreWorker:
                                       self.port)
         self.node_id = reply["node_id"]
         self.store_dir = reply["store_dir"]
+        spawn(self._task_event_flusher())
 
     @property
     def address(self) -> Address:
@@ -211,6 +217,38 @@ class CoreWorker:
             c = RpcClient(addr, max_retries=3)
             self._worker_clients[addr] = c
         return c
+
+    # ------------------------------------------------------------------
+    # task events (owner-side; reference: task_event_buffer.cc)
+    # ------------------------------------------------------------------
+    def _record_task_event(self, task_id: bytes, name: str,
+                           event: str) -> None:
+        import time as _time
+        with self._task_events_lock:
+            self._task_events.append({
+                "task_id": task_id.hex(), "name": name, "event": event,
+                "ts": _time.time(), "owner": self.worker_id.hex()[:8]})
+            full = (len(self._task_events)
+                    >= GlobalConfig.task_events_batch_size)
+        if full:
+            self._flush_task_events()
+
+    def _flush_task_events(self) -> None:
+        with self._task_events_lock:
+            batch, self._task_events = self._task_events, []
+        if batch:
+            self._spawn(self._send_task_events(batch))
+
+    async def _send_task_events(self, batch: list) -> None:
+        try:
+            await self.controller.call("report_task_events", batch)
+        except Exception:
+            pass  # observability is best-effort
+
+    async def _task_event_flusher(self) -> None:
+        while True:
+            await asyncio.sleep(2.0)
+            self._flush_task_events()
 
     # ------------------------------------------------------------------
     # ownership ledger helpers
@@ -770,6 +808,7 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy,
         )
         self._task_arg_refs[task_id.binary()] = held
+        self._record_task_event(task_id.binary(), spec.name, "submitted")
         if streaming:
             from ray_tpu.core.ref import ObjectRefGenerator
             self._streams[task_id.binary()] = _StreamState()
@@ -791,6 +830,7 @@ class CoreWorker:
         try:
             await self._submit_with_retries(spec)
         except BaseException as e:  # mark all returns failed
+            self._record_task_event(spec.task_id, spec.name, "failed")
             err = e if isinstance(e, Exception) else WorkerCrashedError(repr(e))
             if spec.streaming:
                 self._fail_stream(spec.task_id, err)
@@ -997,6 +1037,9 @@ class CoreWorker:
             self.remove_local_ref(ref)
 
     def _process_task_reply(self, spec: TaskSpec, reply: dict) -> None:
+        self._record_task_event(
+            spec.task_id, spec.name,
+            "failed" if reply.get("error") is not None else "finished")
         if reply.get("error") is not None:
             err = serialization.deserialize(reply["error"],
                                             reply["error_meta"])
@@ -1130,6 +1173,7 @@ class CoreWorker:
             max_retries=handle._max_task_retries,
         )
         self._task_arg_refs[task_id.binary()] = held
+        self._record_task_event(task_id.binary(), spec.name, "submitted")
         if streaming:
             from ray_tpu.core.ref import ObjectRefGenerator
             self._streams[task_id.binary()] = _StreamState()
@@ -1149,6 +1193,7 @@ class CoreWorker:
         try:
             await self._submit_actor_with_retries(spec)
         except BaseException as e:
+            self._record_task_event(spec.task_id, spec.name, "failed")
             err = e if isinstance(e, Exception) else WorkerCrashedError(repr(e))
             if spec.streaming:
                 self._fail_stream(spec.task_id, err)

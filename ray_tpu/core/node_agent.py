@@ -153,6 +153,7 @@ class NodeAgent:
             self.resources_total, self.labels)
         spawn(self._heartbeat_loop())
         spawn(self._reap_loop())
+        spawn(self._metrics_loop())
         # Cluster membership via controller pubsub (reference: raylets
         # subscribe to GCS node-info channel, not direct RPC pushes).
         self._node_sub = Subscription(
@@ -175,6 +176,34 @@ class NodeAgent:
             except Exception as e:
                 logger.debug("heartbeat failed: %r", e)
             await asyncio.sleep(period)
+
+    async def _metrics_loop(self) -> None:
+        """Push this node's metric registry to the controller every
+        metrics_report_period_ms (reference: per-node metrics agent,
+        _private/metrics_agent.py -> Prometheus)."""
+        from ray_tpu.utils import metrics as M
+        store_used = M.Gauge("raytpu_object_store_used_bytes",
+                             "shm object store bytes in use")
+        store_objs = M.Gauge("raytpu_object_store_objects",
+                             "objects resident in the shm store")
+        spilled = M.Gauge("raytpu_objects_spilled_total",
+                          "objects spilled to disk")
+        workers = M.Gauge("raytpu_workers", "worker processes alive")
+        leases = M.Gauge("raytpu_active_leases", "granted worker leases")
+        period = max(0.5, GlobalConfig.metrics_report_period_ms / 1000)
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            try:
+                store_used.set(self.store.used())
+                store_objs.set(self.store.num_objects())
+                spilled.set(self.num_spilled)
+                workers.set(len(self.workers))
+                leases.set(len(self.leases))
+                await self.controller.call(
+                    "report_metrics", self.node_id.binary(),
+                    M.snapshot_all())
+            except Exception as e:
+                logger.debug("metrics push failed: %r", e)
 
     async def _reap_loop(self) -> None:
         """Monitor child worker processes; clean up on death; retire idle

@@ -66,14 +66,17 @@ def device_get(ref: DeviceRef, *, sharding: Optional[Any] = None,
         local = cw.get_device_object_local(ref.key)
         if local is None:
             raise KeyError(f"device object freed: {ref}")
+        if sharding is not None:  # honor the contract on BOTH paths
+            import jax
+            return jax.device_put(local, sharding)
         return local
     client = cw._client_for_worker(ref.owner_addr)
     got = cw._run(client.call("fetch_device_object",
                               ref.key)).result(timeout)
     if got is None:
         raise KeyError(f"device object freed on owner: {ref}")
-    data, dtype, shape = got
-    host = np.frombuffer(data, dtype=np.dtype(dtype)).reshape(shape)
+    data, _dtype, _shape = got  # pickle-5 already rebuilt the ndarray
+    host = np.asarray(data)
     try:
         import jax
         return jax.device_put(host, sharding) if sharding is not None \

@@ -1,0 +1,90 @@
+"""State API: programmatic cluster introspection.
+
+Analogue of the reference's state API (reference: python/ray/util/state/
+api.py list_nodes/list_actors/list_tasks + dashboard/state_aggregator.py;
+`ray list ...` CLI). Sources: controller tables + per-agent stats RPCs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import api as _api
+
+
+def _ctl(method: str, *args, timeout: float = 30.0):
+    cw = _api._cw()
+    return cw._run(cw.controller.call(method, *args)).result(timeout)
+
+
+def list_nodes() -> List[dict]:
+    out = []
+    for n in _ctl("get_nodes"):
+        out.append({
+            "node_id": n["node_id"].hex()[:12],
+            "state": n["state"],
+            "addr": f"{n['addr'][0]}:{n['addr'][1]}",
+            "resources_total": n["resources_total"],
+            "resources_available": n["resources_available"],
+            "labels": n["labels"],
+        })
+    return out
+
+
+def list_actors() -> List[dict]:
+    return [{
+        "actor_id": a["actor_id"].hex()[:12],
+        "name": a["name"],
+        "state": a["state"],
+        "node_id": a["node_id"].hex()[:12] if a["node_id"] else "",
+        "restarts": a["restarts"],
+    } for a in _ctl("list_actors")]
+
+
+def list_tasks(limit: int = 100) -> List[dict]:
+    return _ctl("list_task_events", limit)
+
+
+def list_workers() -> List[dict]:
+    """Per-node agent stats (workers, store, spill, event stats)."""
+    cw = _api._cw()
+    out = []
+    for n in _ctl("get_nodes"):
+        if n["state"] != "ALIVE":
+            continue
+        try:
+            stats = cw._run(cw._client_for_worker(
+                tuple(n["addr"])).call("agent_stats")).result(15)
+            stats["node_id"] = stats["node_id"].hex()[:12]
+            out.append(stats)
+        except Exception:
+            pass
+    return out
+
+
+def cluster_summary() -> dict:
+    res = _ctl("cluster_resources")
+    nodes = list_nodes()
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["state"] == "ALIVE"),
+        "nodes_total": len(nodes),
+        "resources_total": res["total"],
+        "resources_available": res["available"],
+        "actors": len(list_actors()),
+    }
+
+
+def metrics_text() -> str:
+    return _ctl("metrics_text")
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace events for every recorded task; pass filename to dump
+    JSON loadable in chrome://tracing / Perfetto (reference:
+    `ray timeline`)."""
+    trace = _ctl("timeline")
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

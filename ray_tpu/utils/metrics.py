@@ -1,0 +1,127 @@
+"""Metrics: Counter/Gauge/Histogram with a process-local registry.
+
+Analogue of the reference's metrics stack (reference: src/ray/stats/
+metric.cc + python/ray/util/metrics.py user-defined metrics; export via
+the per-node agent to Prometheus). Here: components record into the
+process registry; node agents push snapshots to the controller every
+``metrics_report_period_ms``; the controller aggregates and renders a
+Prometheus-style text exposition for scraping/CLI.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: Dict[Tuple, float] = {}
+        with _lock:
+            _registry[name] = self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        tags = tags or {}
+        return tuple(tags.get(k, "") for k in self.tag_keys)
+
+    def snapshot(self) -> List[Tuple[Tuple, float]]:
+        with _lock:
+            return list(self._values.items())
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(tags)
+        with _lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with _lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    """Fixed-boundary histogram (counts per bucket + sum/count)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or
+                                 [0.001, 0.01, 0.1, 1, 10, 100])
+        self._buckets: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._counts: Dict[Tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(tags)
+        with _lock:
+            b = self._buckets.setdefault(
+                k, [0] * (len(self.boundaries) + 1))
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            b[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def snapshot(self):
+        with _lock:
+            return [(k, {"buckets": list(v),
+                         "sum": self._sums.get(k, 0.0),
+                         "count": self._counts.get(k, 0)})
+                    for k, v in self._buckets.items()]
+
+
+def snapshot_all() -> Dict[str, dict]:
+    """Serializable registry snapshot (pushed to the controller)."""
+    with _lock:
+        metrics = list(_registry.values())
+    return {m.name: {"kind": m.kind, "description": m.description,
+                     "tag_keys": m.tag_keys, "values": m.snapshot()}
+            for m in metrics}
+
+
+def render_prometheus(per_node: Dict[str, Dict[str, dict]]) -> str:
+    """{node_hex: snapshot_all()} -> Prometheus text exposition."""
+    lines: List[str] = []
+    seen_help = set()
+    for node, snap in sorted(per_node.items()):
+        for name, m in sorted(snap.items()):
+            if name not in seen_help:
+                lines.append(f"# HELP {name} {m['description']}")
+                lines.append(f"# TYPE {name} {m['kind']}")
+                seen_help.add(name)
+            for tags_tuple, value in m["values"]:
+                tag_parts = [f'node="{node}"'] + [
+                    f'{k}="{v}"' for k, v in zip(m["tag_keys"],
+                                                 tags_tuple)]
+                tag_str = "{" + ",".join(tag_parts) + "}"
+                if m["kind"] == "histogram":
+                    lines.append(
+                        f"{name}_sum{tag_str} {value['sum']}")
+                    lines.append(
+                        f"{name}_count{tag_str} {value['count']}")
+                else:
+                    lines.append(f"{name}{tag_str} {value}")
+    return "\n".join(lines) + "\n"

@@ -20,15 +20,11 @@ def cluster():
     c.shutdown()
 
 
+from ray_tpu.collective import CollectiveMixin
+
+
 @ray_tpu.remote
-class Member:
-    from ray_tpu.collective import CollectiveMixin as _Mixin
-
-    def declare_collective_group(self, *args):
-        from ray_tpu import collective as col
-        col._declare_group(*args)
-        return True
-
+class Member(CollectiveMixin):
     def do_allreduce(self, value):
         from ray_tpu import collective as col
         out = col.allreduce(np.full(4, float(value)), "g")
@@ -71,37 +67,37 @@ def _group(n):
 def test_allreduce_and_allgather(cluster):
     actors = _group(3)
     outs = ray_tpu.get([a.do_allreduce.remote(i + 1)
-                        for i, a in enumerate(actors)], timeout=60)
+                        for i, a in enumerate(actors)], timeout=180)
     assert all(o == [6.0] * 4 for o in outs)  # 1+2+3
     gathers = ray_tpu.get([a.do_allgather.remote(i * 10)
-                           for i, a in enumerate(actors)], timeout=60)
+                           for i, a in enumerate(actors)], timeout=180)
     assert all(g == [[0], [10], [20]] for g in gathers)
 
 
 def test_broadcast_and_barrier(cluster):
     actors = _group(3)
     outs = ray_tpu.get([a.do_broadcast.remote(i + 7)
-                        for i, a in enumerate(actors)], timeout=60)
+                        for i, a in enumerate(actors)], timeout=180)
     assert all(o == [7] for o in outs)  # rank 0's value everywhere
     ranks = ray_tpu.get([a.do_barrier_then_rank.remote()
-                         for a in actors], timeout=60)
+                         for a in actors], timeout=180)
     assert sorted(ranks) == [0, 1, 2]
 
 
 def test_device_ref_out_of_band(cluster):
     producer, consumer = Member.remote(), Member.remote()
-    ref = ray_tpu.get(producer.make_device_ref.remote(8), timeout=60)
+    ref = ray_tpu.get(producer.make_device_ref.remote(8), timeout=180)
     from ray_tpu.device_objects import DeviceRef
     assert isinstance(ref, DeviceRef)
     assert ref.shape == (8,)
     # The ref travels the control plane; the tensor moves out-of-band.
-    out = ray_tpu.get(consumer.read_device_ref.remote(ref), timeout=60)
+    out = ray_tpu.get(consumer.read_device_ref.remote(ref), timeout=180)
     assert out == [float(i) for i in range(8)]
 
 
 def test_device_ref_free(cluster):
     producer, consumer = Member.remote(), Member.remote()
-    ref = ray_tpu.get(producer.make_device_ref.remote(4), timeout=60)
+    ref = ray_tpu.get(producer.make_device_ref.remote(4), timeout=180)
 
     @ray_tpu.remote
     def free_it(r):
@@ -109,6 +105,6 @@ def test_device_ref_free(cluster):
         free_ref(r)
         return True
 
-    assert ray_tpu.get(free_it.remote(ref), timeout=60)
+    assert ray_tpu.get(free_it.remote(ref), timeout=180)
     with pytest.raises(Exception):
-        ray_tpu.get(consumer.read_device_ref.remote(ref), timeout=60)
+        ray_tpu.get(consumer.read_device_ref.remote(ref), timeout=180)

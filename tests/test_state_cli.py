@@ -1,0 +1,106 @@
+"""State API, task events/timeline, metrics pipeline, CLI.
+
+Mirrors the reference's state/observability coverage (reference:
+python/ray/tests/test_state_api.py, `ray timeline`/`ray list` CLI,
+metrics agent pipeline) at this framework's scale.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.core.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(num_nodes=1, resources={"CPU": 4})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_list_nodes_and_summary(cluster):
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+    s = state.cluster_summary()
+    assert s["nodes_alive"] == 1
+    assert s["resources_total"]["CPU"] == 4.0
+
+
+def test_list_actors(cluster):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    actors = state.list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+
+
+def test_task_events_and_timeline(cluster, tmp_path):
+    @ray_tpu.remote
+    def traced_task(x):
+        time.sleep(0.05)
+        return x
+
+    ray_tpu.get([traced_task.remote(i) for i in range(5)])
+    from ray_tpu import api
+    api._cw()._flush_task_events()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        tasks = state.list_tasks(limit=1000)
+        names = [t["name"] for t in tasks]
+        if names.count("traced_task") >= 10:  # submitted + finished
+            break
+        time.sleep(0.2)
+    assert names.count("traced_task") >= 10
+
+    out = str(tmp_path / "trace.json")
+    trace = state.timeline(out)
+    spans = [e for e in trace if e["name"] == "traced_task"]
+    assert len(spans) >= 5
+    assert all(e["ph"] == "X" and e["dur"] >= 0.05 * 1e6 * 0.5
+               for e in spans)
+    assert json.load(open(out))  # valid chrome-trace JSON
+
+
+def test_metrics_pipeline(cluster):
+    from ray_tpu.utils.config import GlobalConfig
+    deadline = time.monotonic() + 3 * (
+        GlobalConfig.metrics_report_period_ms / 1000) + 10
+    text = ""
+    while time.monotonic() < deadline:
+        text = state.metrics_text()
+        if "raytpu_object_store_used_bytes" in text:
+            break
+        time.sleep(0.5)
+    assert "raytpu_object_store_used_bytes" in text
+    assert "# TYPE raytpu_workers gauge" in text
+
+
+def test_cli_status_and_list(cluster):
+    from ray_tpu import api
+    host, port = api._cw().controller_addr
+    addr = f"{host}:{port}"
+    env = {"PYTHONPATH": ".", "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    import os
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.cli", "status", "--address", addr],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr
+    assert "nodes: 1/1 alive" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.cli", "list", "nodes",
+         "--address", addr],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)[0]["state"] == "ALIVE"
