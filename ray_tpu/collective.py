@@ -74,13 +74,26 @@ class _Coordinator:
         else:
             try:
                 # A rank that died before contributing must not wedge the
-                # group forever: time out, clean up, surface the failure.
+                # group forever: time out, surface the failure.
                 await asyncio.wait_for(st["event"].wait(), 300.0)
             except asyncio.TimeoutError:
-                self._pending.pop(key, None)
-                raise RuntimeError(
-                    f"collective {op!r} timed out: only "
-                    f"{len(st['parts'])}/{self._world} ranks arrived")
+                # Mark failed IN PLACE (don't pop): late/concurrent ranks
+                # must see the same failure, not complete against an
+                # orphaned entry or start a fresh 300s wait.
+                if st.get("error") is None and not st["event"].is_set():
+                    st["error"] = RuntimeError(
+                        f"only {len(st['parts'])}/{self._world} ranks "
+                        f"arrived within 300s")
+                    st["event"].set()
+
+                    async def _gc_later(key=key):
+                        # Dead ranks never read: drop the failed entry
+                        # (and its payload arrays) eventually.
+                        await asyncio.sleep(600)
+                        self._pending.pop(key, None)
+
+                    from ray_tpu.utils.aio import spawn
+                    spawn(_gc_later())
         err = st.get("error")
         result = st["result"]
         # Last reader cleans up (every rank reads exactly once).

@@ -1,0 +1,21 @@
+"""ray_tpu.rllib — reinforcement learning on the actor runtime.
+
+Analogue of RLlib's core loop (reference: rllib/ — Algorithm/
+AlgorithmConfig, EnvRunnerGroup of rollout actors, Learner with the PPO
+clipped-surrogate loss), minimum slice: PPO with parallel env-runner
+actors and a jitted JAX learner.
+
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.env import CartPole
+
+    algo = (PPOConfig().environment(CartPole)
+            .env_runners(4, rollout_fragment_length=512).build())
+    for _ in range(20):
+        print(algo.train()["episode_return_mean"])
+"""
+
+from ray_tpu.rllib.env import CartPole, Env
+from ray_tpu.rllib.learner import PPOLearner
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = ["CartPole", "Env", "PPO", "PPOConfig", "PPOLearner"]

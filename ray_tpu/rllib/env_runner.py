@@ -1,0 +1,98 @@
+"""EnvRunner — rollout-collecting actor.
+
+Analogue of the reference's env runners (reference: rllib/env/
+single_agent_env_runner.py — step envs with the current policy, return
+sample batches; env_runner_group.py fans N of them out as actors). The
+policy forward runs on the runner's host devices (numpy MLP mirror of the
+learner net — env stepping is host work; shipping obs to the TPU per step
+would be all latency).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import cloudpickle
+import numpy as np
+
+
+def _np_forward(layers: List[dict], x: np.ndarray) -> np.ndarray:
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(layers) - 1:
+            x = np.tanh(x)
+    return x
+
+
+def _log_softmax(z: np.ndarray) -> np.ndarray:
+    z = z - z.max(axis=-1, keepdims=True)
+    return z - np.log(np.exp(z).sum(axis=-1, keepdims=True))
+
+
+class EnvRunner:
+    def __init__(self, env_maker_blob: bytes, seed: int = 0):
+        self._env = cloudpickle.loads(env_maker_blob)()
+        self._rng = np.random.RandomState(seed)
+        self._seed = seed
+        self._weights: Dict[str, Any] = {}
+        self._obs = self._env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._completed_returns: List[float] = []
+
+    def set_weights(self, weights: Dict[str, Any]) -> bool:
+        self._weights = weights
+        return True
+
+    def sample(self, num_steps: int, gamma: float = 0.99,
+               gae_lambda: float = 0.95) -> Dict[str, np.ndarray]:
+        """Collect num_steps transitions; returns the PPO batch with GAE
+        advantages computed runner-side (reference: ConnectorV2 GAE)."""
+        obs_buf = np.zeros((num_steps, self._env.observation_size),
+                           np.float32)
+        act_buf = np.zeros(num_steps, np.int32)
+        rew_buf = np.zeros(num_steps, np.float32)
+        done_buf = np.zeros(num_steps, np.float32)
+        logp_buf = np.zeros(num_steps, np.float32)
+        val_buf = np.zeros(num_steps + 1, np.float32)
+
+        pi, vf = self._weights["pi"], self._weights["vf"]
+        self._completed_returns = []
+        obs = self._obs
+        for t in range(num_steps):
+            logp = _log_softmax(_np_forward(pi, obs[None, :]))[0]
+            action = int(self._rng.choice(len(logp), p=np.exp(logp)))
+            value = float(_np_forward(vf, obs[None, :])[0, 0])
+            nxt, rew, term, trunc, _ = self._env.step(action)
+            obs_buf[t] = obs
+            act_buf[t] = action
+            rew_buf[t] = rew
+            logp_buf[t] = logp[action]
+            val_buf[t] = value
+            done_buf[t] = float(term)
+            self._episode_return += rew
+            if term or trunc:
+                self._completed_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                obs = self._env.reset(
+                    seed=int(self._rng.randint(0, 2 ** 31)))
+            else:
+                obs = nxt
+        self._obs = obs
+        val_buf[num_steps] = float(_np_forward(vf, obs[None, :])[0, 0])
+
+        # GAE(lambda) advantages + returns.
+        adv = np.zeros(num_steps, np.float32)
+        last = 0.0
+        for t in reversed(range(num_steps)):
+            nonterminal = 1.0 - done_buf[t]
+            delta = rew_buf[t] + gamma * val_buf[t + 1] * nonterminal \
+                - val_buf[t]
+            last = delta + gamma * gae_lambda * nonterminal * last
+            adv[t] = last
+        returns = adv + val_buf[:num_steps]
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp_old": logp_buf,
+            "advantages": adv, "returns": returns,
+            "episode_returns": np.asarray(self._completed_returns,
+                                          np.float32),
+        }

@@ -1,0 +1,123 @@
+"""PPO learner — pure-JAX policy/value nets + clipped surrogate update.
+
+Analogue of the reference's learner stack (reference: rllib/core/learner/
+learner.py + algorithms/ppo/ppo_torch_learner.py loss; RLModule forward),
+TPU-first: one jitted update over the whole rollout batch (minibatch loop
+as a lax.scan-free python loop over jitted steps — batch sizes are static),
+bf16-friendly MLPs on the default device (TPU when present).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+def _mlp_init(key, sizes):
+    import jax
+    import jax.numpy as jnp
+
+    params = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (fan_in, fan_out)) * np.sqrt(
+            2.0 / fan_in)
+        params.append({"w": w.astype(jnp.float32),
+                       "b": jnp.zeros(fan_out, jnp.float32)})
+    return params
+
+
+def _mlp_apply(params, x):
+    import jax.numpy as jnp
+
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class PPOLearner:
+    """Holds policy+value params and performs PPO updates."""
+
+    def __init__(self, obs_size: int, num_actions: int, *,
+                 hidden: Tuple[int, ...] = (64, 64), lr: float = 3e-4,
+                 clip: float = 0.2, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        key = jax.random.PRNGKey(seed)
+        k1, k2 = jax.random.split(key)
+        self.params = {
+            "pi": _mlp_init(k1, (obs_size, *hidden, num_actions)),
+            "vf": _mlp_init(k2, (obs_size, *hidden, 1)),
+        }
+        self._opt = optax.adam(lr)
+        self._opt_state = self._opt.init(self.params)
+
+        def loss_fn(params, batch):
+            logits = _mlp_apply(params["pi"], batch["obs"])
+            values = _mlp_apply(params["vf"], batch["obs"])[:, 0]
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp_old"])
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            unclipped = ratio * adv
+            clipped = jnp.clip(ratio, 1 - clip, 1 + clip) * adv
+            pi_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+            vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._update = update
+
+        @jax.jit
+        def action_dist(params, obs):
+            logits = _mlp_apply(params["pi"], obs)
+            values = _mlp_apply(params["vf"], obs)[:, 0]
+            return jax.nn.log_softmax(logits), values
+
+        self._action_dist = action_dist
+
+    def get_weights(self) -> Any:
+        import jax
+        return jax.tree.map(np.asarray, self.params)
+
+    def update_minibatches(self, batch: Dict[str, np.ndarray], *,
+                           num_epochs: int = 4,
+                           minibatch_size: int = 128) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        n = len(batch["obs"])
+        # Static minibatch shapes: truncate to a multiple (XLA recompiles
+        # per shape otherwise).
+        num_mb = max(1, n // minibatch_size)
+        usable = num_mb * minibatch_size
+        rng = np.random.RandomState(0)
+        metrics: Dict[str, float] = {}
+        for _ in range(num_epochs):
+            perm = rng.permutation(n)[:usable]
+            for i in range(num_mb):
+                idx = perm[i * minibatch_size:(i + 1) * minibatch_size]
+                mb = {k: jnp.asarray(v[idx]) for k, v in batch.items()}
+                self.params, self._opt_state, aux = self._update(
+                    self.params, self._opt_state, mb)
+        return {k: float(v) for k, v in aux.items()}

@@ -66,12 +66,14 @@ def list_workers() -> List[dict]:
 def cluster_summary() -> dict:
     res = _ctl("cluster_resources")
     nodes = list_nodes()
+    actors = list_actors()
     return {
         "nodes_alive": sum(1 for n in nodes if n["state"] == "ALIVE"),
         "nodes_total": len(nodes),
         "resources_total": res["total"],
         "resources_available": res["available"],
-        "actors": len(list_actors()),
+        "actors": sum(1 for a in actors if a["state"] == "ALIVE"),
+        "actors_total": len(actors),
     }
 
 

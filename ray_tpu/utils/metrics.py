@@ -88,6 +88,7 @@ class Histogram(Metric):
     def snapshot(self):
         with _lock:
             return [(k, {"buckets": list(v),
+                         "boundaries": list(self.boundaries),
                          "sum": self._sums.get(k, 0.0),
                          "count": self._counts.get(k, 0)})
                     for k, v in self._buckets.items()]
@@ -118,6 +119,14 @@ def render_prometheus(per_node: Dict[str, Dict[str, dict]]) -> str:
                                                  tags_tuple)]
                 tag_str = "{" + ",".join(tag_parts) + "}"
                 if m["kind"] == "histogram":
+                    bounds = value.get("boundaries") or []
+                    cum = 0
+                    for bi, count in enumerate(value["buckets"]):
+                        cum += count
+                        le = (f"{bounds[bi]}" if bi < len(bounds)
+                              else "+Inf")
+                        btags = tag_str[:-1] + f',le="{le}"}}'
+                        lines.append(f"{name}_bucket{btags} {cum}")
                     lines.append(
                         f"{name}_sum{tag_str} {value['sum']}")
                     lines.append(

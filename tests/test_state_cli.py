@@ -90,7 +90,6 @@ def test_cli_status_and_list(cluster):
     from ray_tpu import api
     host, port = api._cw().controller_addr
     addr = f"{host}:{port}"
-    env = {"PYTHONPATH": ".", "PATH": "/usr/bin:/bin:/usr/local/bin"}
     import os
     env = dict(os.environ)
     out = subprocess.run(
