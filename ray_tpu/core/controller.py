@@ -100,6 +100,118 @@ class Controller:
         from collections import deque
         self.task_events: "deque" = deque(maxlen=50000)
         self.node_metrics: Dict[str, dict] = {}
+        # Persistence (reference: gcs/store_client/redis_store_client.cc +
+        # gcs_init_data.cc rebuild-on-restart). A snapshot file holds the
+        # durable tables: KV (function table!), actors, named actors, PGs,
+        # jobs. Node entries are NOT persisted — agents re-register via
+        # the heartbeat "unknown" signal.
+        self._storage_path = GlobalConfig.gcs_storage_path
+        self._dirty = False
+        if self._storage_path:
+            self._restore_state()
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+    def _mark_dirty(self) -> None:
+        self._dirty = True
+
+    def _restore_state(self) -> None:
+        import os
+        import pickle
+        if not os.path.exists(self._storage_path):
+            return
+        try:
+            with open(self._storage_path, "rb") as f:
+                snap = pickle.load(f)
+        except Exception as e:
+            logger.warning("could not restore controller state: %r", e)
+            return
+        self.kv = snap.get("kv", {})
+        self.named_actors = snap.get("named_actors", {})
+        self.jobs = snap.get("jobs", {})
+        self._next_job = snap.get("next_job", 1)
+        for a in snap.get("actors", []):
+            entry = ActorEntry(a["actor_id"], a["spec_blob"], a["name"],
+                               a["max_restarts"], a["resources"],
+                               a["placement"], a["runtime_env"])
+            entry.state = a["state"]
+            entry.addr = a["addr"]
+            entry.node_id = a["node_id"]
+            entry.restarts_used = a["restarts_used"]
+            entry.death_reason = a["death_reason"]
+            if entry.state in (ActorState.ALIVE, ActorState.DEAD):
+                entry.event.set()
+            self.actors[a["actor_id"]] = entry
+        for p in snap.get("pgs", []):
+            pg = PGEntry(p["pg_id"], p["bundles"], p["strategy"])
+            pg.state = p["state"]
+            pg.bundle_nodes = p["bundle_nodes"]
+            if pg.state != PGState.PENDING:
+                pg.event.set()
+            self.pgs[p["pg_id"]] = pg
+        logger.info("restored controller state: %d actors, %d pgs, "
+                    "%d kv namespaces", len(self.actors), len(self.pgs),
+                    len(self.kv))
+
+    def _snapshot_state(self) -> None:
+        import os
+        import pickle
+        snap = {
+            "kv": self.kv,
+            "named_actors": self.named_actors,
+            "jobs": self.jobs,
+            "next_job": self._next_job,
+            "actors": [{
+                "actor_id": e.actor_id, "spec_blob": e.spec_blob,
+                "name": e.name, "max_restarts": e.max_restarts,
+                "resources": e.resources, "placement": e.placement,
+                "runtime_env": e.runtime_env, "state": e.state,
+                "addr": e.addr, "node_id": e.node_id,
+                "restarts_used": e.restarts_used,
+                "death_reason": e.death_reason,
+            } for e in self.actors.values()],
+            "pgs": [{
+                "pg_id": p.pg_id, "bundles": p.bundles,
+                "strategy": p.strategy, "state": p.state,
+                "bundle_nodes": p.bundle_nodes,
+            } for p in self.pgs.values()],
+        }
+        tmp = self._storage_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(snap, f)
+        os.replace(tmp, self._storage_path)  # atomic swap
+
+    async def _resume_restored(self) -> None:
+        """After a restart: re-drive restored PENDING work and fail over
+        restored-ALIVE actors whose nodes never re-register (their
+        heartbeat-timeout path can't fire — the node table starts
+        empty)."""
+        for pg in self.pgs.values():
+            if pg.state == PGState.PENDING:
+                spawn(self._schedule_pg(pg))
+        for actor in self.actors.values():
+            if actor.state in (ActorState.PENDING, ActorState.RESTARTING):
+                spawn(self._schedule_actor(actor))
+        grace = GlobalConfig.health_check_timeout_ms / 1000
+        await asyncio.sleep(grace)
+        for actor in list(self.actors.values()):
+            if actor.state == ActorState.ALIVE and (
+                    actor.node_id not in self.nodes
+                    or self.nodes[actor.node_id].state != NodeState.ALIVE):
+                spawn(self._handle_actor_failure(
+                    actor, "node did not return after controller restart"))
+
+    async def _persist_loop(self) -> None:
+        """Debounced snapshotting: flush dirty state every 500ms."""
+        while True:
+            await asyncio.sleep(0.5)
+            if self._dirty:
+                self._dirty = False
+                try:
+                    self._snapshot_state()
+                except Exception as e:
+                    logger.warning("controller snapshot failed: %r", e)
 
     # ------------------------------------------------------------------
     # observability (metrics + task events + timeline)
@@ -154,6 +266,7 @@ class Controller:
         return await self.pubsub.poll(channel, from_seq, min(timeout, 60.0))
 
     def _publish_actor_event(self, e: "ActorEntry") -> None:
+        self._mark_dirty()  # every actor state transition publishes
         self.pubsub.publish("actor_events", {
             "actor_id": e.actor_id, "state": e.state, "addr": e.addr,
             "death_reason": e.death_reason,
@@ -173,9 +286,14 @@ class Controller:
             "type": "added", "node_id": node_id, "addr": addr})
         return {"num_nodes": len(self.nodes)}
 
-    async def heartbeat(self, node_id: bytes, resources_available: dict) -> bool:
+    async def heartbeat(self, node_id: bytes, resources_available: dict):
         node = self.nodes.get(node_id)
-        if node is None or node.state == NodeState.DEAD:
+        if node is None:
+            # Fresh controller (restart) that never saw this node: tell
+            # the agent to RE-REGISTER (reference: raylets resubscribe on
+            # HandleNotifyGCSRestart, node_manager.cc:923).
+            return "unknown"
+        if node.state == NodeState.DEAD:
             return False  # tells a zombie agent to shut down
         node.last_heartbeat = time.monotonic()
         node.resources_available = resources_available
@@ -293,6 +411,7 @@ class Controller:
                            tuple(placement) if placement else None,
                            runtime_env)
         self.actors[actor_id] = entry
+        self._mark_dirty()
         spawn(self._schedule_actor(entry))
         return {"actor_id": actor_id}
 
@@ -426,6 +545,7 @@ class Controller:
                                      strategy: str) -> dict:
         pg = PGEntry(pg_id, bundles, strategy)
         self.pgs[pg_id] = pg
+        self._mark_dirty()
         spawn(self._schedule_pg(pg))
         return {"pg_id": pg_id}
 
@@ -493,6 +613,7 @@ class Controller:
                         pg.bundle_nodes[i] = node.node_id
                     pg.state = PGState.CREATED
                     pg.event.set()
+                    self._mark_dirty()
                     return
                 for node, i in prepared:  # rollback
                     try:
@@ -502,6 +623,7 @@ class Controller:
             await asyncio.sleep(0.2)
         pg.state = PGState.REMOVED
         pg.event.set()
+        self._mark_dirty()
 
     @long_poll
     async def wait_pg_ready(self, pg_id: bytes, timeout: float = 60.0) -> str:
@@ -519,6 +641,7 @@ class Controller:
         pg = self.pgs.pop(pg_id, None)
         if pg is None:
             return
+        self._mark_dirty()
         for i, node_id in enumerate(pg.bundle_nodes):
             node = self.nodes.get(node_id) if node_id else None
             if node and node.state == NodeState.ALIVE:
@@ -544,12 +667,14 @@ class Controller:
         if not overwrite and key in space:
             return False
         space[key] = value
+        self._mark_dirty()
         return True
 
     async def kv_get(self, ns: str, key: str) -> Optional[bytes]:
         return self.kv.get(ns, {}).get(key)
 
     async def kv_del(self, ns: str, key: str) -> bool:
+        self._mark_dirty()
         return self.kv.get(ns, {}).pop(key, None) is not None
 
     async def kv_keys(self, ns: str, prefix: str = "") -> list:
@@ -561,6 +686,7 @@ class Controller:
     async def register_job(self, driver_addr) -> bytes:
         job_id = self._next_job.to_bytes(4, "big")
         self._next_job += 1
+        self._mark_dirty()
         self.jobs[job_id] = {"driver_addr": tuple(driver_addr),
                              "start_time": time.time(), "state": "RUNNING"}
         return job_id
@@ -568,6 +694,7 @@ class Controller:
     async def finish_job(self, job_id: bytes) -> None:
         if job_id in self.jobs:
             self.jobs[job_id]["state"] = "FINISHED"
+            self._mark_dirty()
 
     async def cluster_resources(self) -> dict:
         total: Dict[str, float] = {}
@@ -592,6 +719,9 @@ class Controller:
         port = await server.start_tcp(host, port)
         self._server = server
         self._health_task = spawn(self._health_loop())
+        if self._storage_path:
+            spawn(self._persist_loop())
+            spawn(self._resume_restored())
         logger.info("controller listening on %s:%d", host, port)
         return port
 

@@ -171,7 +171,16 @@ class NodeAgent:
                 alive = await self.controller.call(
                     "heartbeat", self.node_id.binary(),
                     self.resources_available)
-                if not alive:
+                if alive == "unknown":
+                    # Controller restarted without our registration:
+                    # re-register with the SAME node id so running
+                    # workers/actors stay addressable.
+                    logger.info("controller restarted; re-registering")
+                    await self.controller.call(
+                        "register_node", self.node_id.binary(),
+                        (self.host, self.port), self.resources_total,
+                        self.labels)
+                elif not alive:
                     logger.warning("controller declared this node dead")
             except Exception as e:
                 logger.debug("heartbeat failed: %r", e)

@@ -132,13 +132,17 @@ class Subscription:
                 logger.debug("pubsub poll on %r failed: %r", self._channel, e)
                 await asyncio.sleep(1.0)
                 continue
-            if reply.get("gap") and self._on_gap is not None:
-                try:
-                    res = self._on_gap()
-                    if asyncio.iscoroutine(res):
-                        await res
-                except Exception:
-                    logger.exception("pubsub on_gap handler failed")
+            # Fell behind the ring OR the publisher restarted (sequence
+            # space reset): resync from authoritative state once.
+            if reply.get("gap") or reply["next_seq"] < self.next_seq:
+                self.next_seq = min(self.next_seq, reply["next_seq"])
+                if self._on_gap is not None:
+                    try:
+                        res = self._on_gap()
+                        if asyncio.iscoroutine(res):
+                            await res
+                    except Exception:
+                        logger.exception("pubsub on_gap handler failed")
             for event in reply["events"]:
                 try:
                     res = self._handler(event)

@@ -58,6 +58,10 @@ class EnvRunner:
         pi, vf = self._weights["pi"], self._weights["vf"]
         self._completed_returns = []
         obs = self._obs
+        # Bootstrap values at TRUNCATION steps use V(final pre-reset obs)
+        # — using the next episode's reset obs would leak value across
+        # episode boundaries and bias GAE at every truncation.
+        trunc_values: Dict[int, float] = {}
         for t in range(num_steps):
             logp = _log_softmax(_np_forward(pi, obs[None, :]))[0]
             action = int(self._rng.choice(len(logp), p=np.exp(logp)))
@@ -71,6 +75,9 @@ class EnvRunner:
             done_buf[t] = float(term)
             self._episode_return += rew
             if term or trunc:
+                if trunc and not term:
+                    trunc_values[t] = float(
+                        _np_forward(vf, nxt[None, :])[0, 0])
                 self._completed_returns.append(self._episode_return)
                 self._episode_return = 0.0
                 obs = self._env.reset(
@@ -80,14 +87,21 @@ class EnvRunner:
         self._obs = obs
         val_buf[num_steps] = float(_np_forward(vf, obs[None, :])[0, 0])
 
-        # GAE(lambda) advantages + returns.
+        # GAE(lambda) advantages + returns. The recursion resets across
+        # episode boundaries (term OR trunc); truncation bootstraps.
         adv = np.zeros(num_steps, np.float32)
         last = 0.0
         for t in reversed(range(num_steps)):
-            nonterminal = 1.0 - done_buf[t]
-            delta = rew_buf[t] + gamma * val_buf[t + 1] * nonterminal \
-                - val_buf[t]
-            last = delta + gamma * gae_lambda * nonterminal * last
+            terminated = done_buf[t] > 0
+            truncated = t in trunc_values
+            if terminated:
+                v_next, nonterminal, carry = 0.0, 0.0, 0.0
+            elif truncated:
+                v_next, nonterminal, carry = trunc_values[t], 1.0, 0.0
+            else:
+                v_next, nonterminal, carry = val_buf[t + 1], 1.0, 1.0
+            delta = rew_buf[t] + gamma * v_next * nonterminal - val_buf[t]
+            last = delta + gamma * gae_lambda * carry * last
             adv[t] = last
         returns = adv + val_buf[:num_steps]
         return {

@@ -89,14 +89,6 @@ class PPOLearner:
 
         self._update = update
 
-        @jax.jit
-        def action_dist(params, obs):
-            logits = _mlp_apply(params["pi"], obs)
-            values = _mlp_apply(params["vf"], obs)[:, 0]
-            return jax.nn.log_softmax(logits), values
-
-        self._action_dist = action_dist
-
     def get_weights(self) -> Any:
         import jax
         return jax.tree.map(np.asarray, self.params)
@@ -109,10 +101,10 @@ class PPOLearner:
         n = len(batch["obs"])
         # Static minibatch shapes: truncate to a multiple (XLA recompiles
         # per shape otherwise).
+        assert num_epochs >= 1
         num_mb = max(1, n // minibatch_size)
         usable = num_mb * minibatch_size
         rng = np.random.RandomState(0)
-        metrics: Dict[str, float] = {}
         for _ in range(num_epochs):
             perm = rng.permutation(n)[:usable]
             for i in range(num_mb):

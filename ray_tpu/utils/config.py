@@ -83,6 +83,7 @@ _flag("streaming_generator_backpressure_items", int, 16, "Yielded-but-unconsumed
 _flag("max_task_retries_default", int, 3, "Default retries for retriable tasks.")
 _flag("actor_max_restarts_default", int, 0, "Default actor restarts.")
 _flag("lineage_pinning_enabled", bool, True, "Pin lineage for object reconstruction.")
+_flag("gcs_storage_path", str, "", "Controller state snapshot file; empty = in-memory only (the reference's Redis-backed GCS fault tolerance analogue).")
 
 # --- chaos / testing (reference: src/ray/rpc/rpc_chaos.cc, RAY_testing_rpc_failure) ---
 _flag("testing_rpc_failure", str, "", "Comma list 'method=prob' to randomly fail RPCs.")
