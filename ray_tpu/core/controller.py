@@ -158,7 +158,8 @@ class Controller:
         import os
         import pickle
         snap = {
-            "kv": self.kv,
+            "kv": {ns: space for ns, space in self.kv.items()
+                   if ns != "pkg"},  # pkg blobs live as side files
             "named_actors": self.named_actors,
             "jobs": self.jobs,
             "next_job": self._next_job,
@@ -211,6 +212,7 @@ class Controller:
                 try:
                     self._snapshot_state()
                 except Exception as e:
+                    self._dirty = True  # retry on the next tick
                     logger.warning("controller snapshot failed: %r", e)
 
     # ------------------------------------------------------------------
@@ -277,13 +279,26 @@ class Controller:
     # node management
     # ------------------------------------------------------------------
     async def register_node(self, node_id: bytes, addr, resources: dict,
-                            labels: dict) -> dict:
+                            labels: dict,
+                            hosted_actors: Optional[list] = None) -> dict:
         addr = tuple(addr)
         self.nodes[node_id] = NodeEntry(node_id, addr, resources, labels)
         logger.info("node registered %s addr=%s resources=%s",
                     node_id.hex()[:8], addr, resources)
         self.pubsub.publish("node_events", {
             "type": "added", "node_id": node_id, "addr": addr})
+        if hosted_actors is not None:
+            # RE-registration after a controller restart: the agent tells
+            # us which actors it still hosts — any restored-ALIVE actor
+            # of this node that ISN'T among them died during the outage
+            # (its death report was lost with the old controller).
+            hosted = set(hosted_actors)
+            for actor in list(self.actors.values()):
+                if (actor.node_id == node_id
+                        and actor.state == ActorState.ALIVE
+                        and actor.actor_id not in hosted):
+                    spawn(self._handle_actor_failure(
+                        actor, "worker died while controller was down"))
         return {"num_nodes": len(self.nodes)}
 
     async def heartbeat(self, node_id: bytes, resources_available: dict):
@@ -667,11 +682,41 @@ class Controller:
         if not overwrite and key in space:
             return False
         space[key] = value
-        self._mark_dirty()
+        if ns == "pkg" and self._storage_path:
+            # Content-addressed package blobs (up to 100MB) persist as
+            # write-once side files — re-pickling them into every 500ms
+            # snapshot would swamp the loop.
+            self._persist_pkg(key, value)
+        else:
+            self._mark_dirty()
         return True
 
+    def _pkg_dir(self) -> str:
+        return self._storage_path + ".pkgs"
+
+    def _persist_pkg(self, key: str, value: bytes) -> None:
+        import os
+        try:
+            os.makedirs(self._pkg_dir(), exist_ok=True)
+            path = os.path.join(self._pkg_dir(), key)
+            if not os.path.exists(path):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(value)
+                os.replace(tmp, path)
+        except Exception as e:
+            logger.warning("pkg persist failed: %r", e)
+
     async def kv_get(self, ns: str, key: str) -> Optional[bytes]:
-        return self.kv.get(ns, {}).get(key)
+        val = self.kv.get(ns, {}).get(key)
+        if val is None and ns == "pkg" and self._storage_path:
+            import os
+            path = os.path.join(self._pkg_dir(), key)
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    val = f.read()
+                self.kv.setdefault(ns, {})[key] = val
+        return val
 
     async def kv_del(self, ns: str, key: str) -> bool:
         self._mark_dirty()

@@ -1128,6 +1128,12 @@ class CoreWorker:
                      max_concurrency: int = 0) -> ActorHandle:
         actor_id = ActorID.random()
         self._ensure_actor_sub()
+        # Package working_dir/py_modules to the controller KV and rewrite
+        # runtime_env into wire form (reference: runtime_env URI packaging).
+        if runtime_env and ("working_dir" in runtime_env
+                            or "py_modules" in runtime_env):
+            from ray_tpu.core.runtime_env import upload_packages
+            runtime_env = upload_packages(self, runtime_env)
         held: List[ObjectRef] = []
         creation = {
             "cls_blob": cloudpickle.dumps(cls),
@@ -1135,6 +1141,7 @@ class CoreWorker:
             "actor_id": actor_id.binary(),
             "max_restarts": max_restarts,
             "max_concurrency": max_concurrency,
+            "runtime_env": runtime_env,
         }
         self._actor_arg_refs[actor_id.binary()] = held
         spec_blob = cloudpickle.dumps(creation)
@@ -1303,6 +1310,14 @@ class CoreWorker:
     @long_poll
     async def create_actor_local(self, spec_blob: bytes) -> None:
         creation = cloudpickle.loads(spec_blob)
+        renv = creation.get("runtime_env")
+        if renv:
+            # working_dir / py_modules land before the user class exists
+            # (env_vars already landed at process spawn).
+            from ray_tpu.core.runtime_env import apply_in_worker
+            loop0 = asyncio.get_running_loop()
+            await loop0.run_in_executor(
+                None, apply_in_worker, self, renv)
         cls = cloudpickle.loads(creation["cls_blob"])
         args, kwargs = await self._resolve_args(creation["args"])
         loop = asyncio.get_running_loop()

@@ -174,12 +174,18 @@ class NodeAgent:
                 if alive == "unknown":
                     # Controller restarted without our registration:
                     # re-register with the SAME node id so running
-                    # workers/actors stay addressable.
+                    # workers/actors stay addressable, and report which
+                    # actors we still host so the controller can fail
+                    # over the ones that died during the outage.
                     logger.info("controller restarted; re-registering")
+                    hosted = [w.dedicated_actor
+                              for w in self.workers.values()
+                              if w.dedicated_actor is not None
+                              and w.proc.poll() is None]
                     await self.controller.call(
                         "register_node", self.node_id.binary(),
                         (self.host, self.port), self.resources_total,
-                        self.labels)
+                        self.labels, hosted_actors=hosted)
                 elif not alive:
                     logger.warning("controller declared this node dead")
             except Exception as e:

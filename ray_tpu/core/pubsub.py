@@ -31,10 +31,15 @@ class PubsubHub:
     """In-process hub: named channels of monotonically-sequenced events."""
 
     def __init__(self, ring_size: int = 4096):
+        import os
         self._ring_size = ring_size
         self._rings: Dict[str, deque] = {}
         self._next_seq: Dict[str, int] = {}
         self._waiters: Dict[str, List[asyncio.Event]] = {}
+        # Epoch id: lets subscribers detect a publisher RESTART (fresh
+        # sequence space) even after the new space catches up to their
+        # old cursor — a bare next_seq comparison cannot.
+        self.epoch = os.urandom(8).hex()
 
     def publish(self, channel: str, event: Any) -> int:
         """Append an event; wake every parked poller on the channel."""
@@ -83,7 +88,8 @@ class PubsubHub:
                 if lst is not None and ev in lst:
                     lst.remove(ev)
             events, nxt, gap = self._collect(channel, from_seq)
-        return {"events": events, "next_seq": nxt, "gap": gap}
+        return {"events": events, "next_seq": nxt, "gap": gap,
+                "epoch": self.epoch}
 
 
 class Subscription:
@@ -109,6 +115,7 @@ class Subscription:
         # from_latest: skip history (a late joiner must not replay stale
         # events, e.g. a "dead" event for an address a new node reuses).
         self.next_seq = -1 if from_latest else 0
+        self._epoch: Optional[str] = None
 
     def start(self) -> "Subscription":
         from ray_tpu.utils.aio import spawn as _spawn
@@ -132,10 +139,17 @@ class Subscription:
                 logger.debug("pubsub poll on %r failed: %r", self._channel, e)
                 await asyncio.sleep(1.0)
                 continue
-            # Fell behind the ring OR the publisher restarted (sequence
-            # space reset): resync from authoritative state once.
-            if reply.get("gap") or reply["next_seq"] < self.next_seq:
-                self.next_seq = min(self.next_seq, reply["next_seq"])
+            # Fell behind the ring OR the publisher restarted (new
+            # epoch = fresh sequence space): resync from authoritative
+            # state once.
+            epoch = reply.get("epoch")
+            restarted = (self._epoch is not None and epoch is not None
+                         and epoch != self._epoch)
+            self._epoch = epoch
+            if reply.get("gap") or restarted:
+                if restarted:
+                    self.next_seq = 0
+                    reply = {"events": [], "next_seq": 0, "gap": False}
                 if self._on_gap is not None:
                     try:
                         res = self._on_gap()
