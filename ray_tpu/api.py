@@ -41,6 +41,11 @@ def init(address: Optional[str] = None, *,
     if _core_worker is not None:
         return {"already_initialized": True}
     if address is None:
+        # Driver scripts launched by job submission (and the reference's
+        # RAY_ADDRESS convention) connect via env.
+        import os
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
+    if address is None:
         _global_node = LocalNode(resources=resources)
         controller_addr = _global_node.controller_addr
         agent_addr = _global_node.agent_addr

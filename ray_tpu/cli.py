@@ -122,6 +122,29 @@ def cmd_stop(args) -> int:
     return 0
 
 
+def cmd_job(args) -> int:
+    _connect(args.address)
+    from ray_tpu import job_submission as jobs
+    if args.action == "submit":
+        import shlex
+        job_id = jobs.submit_job(shlex.join(args.entrypoint))
+        print(f"submitted: {job_id}")
+        if args.wait:
+            status = jobs.wait_job(job_id, timeout=args.timeout)
+            print(f"{job_id}: {status}")
+            print(jobs.get_job_logs(job_id, tail=50), end="")
+            return 0 if status == "SUCCEEDED" else 1
+    elif args.action == "status":
+        print(jobs.get_job_status(args.job_id))
+    elif args.action == "logs":
+        print(jobs.get_job_logs(args.job_id), end="")
+    elif args.action == "stop":
+        print(jobs.stop_job(args.job_id))
+    elif args.action == "list":
+        print(json.dumps(jobs.list_jobs(), indent=2, default=str))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -149,6 +172,17 @@ def main(argv=None) -> int:
     sp.add_argument("--address", required=True)
     sp.add_argument("--out", default="timeline.json")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("job", help="submit/inspect cluster jobs")
+    sp.add_argument("action",
+                    choices=["submit", "status", "logs", "stop", "list"])
+    sp.add_argument("--address", required=True)
+    sp.add_argument("--job-id", default="")
+    sp.add_argument("--wait", action="store_true")
+    sp.add_argument("--timeout", type=float, default=600.0)
+    sp.add_argument("entrypoint", nargs="*",
+                    help="for submit: the shell command to run")
+    sp.set_defaults(fn=cmd_job)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -694,8 +694,18 @@ class Controller:
     def _pkg_dir(self) -> str:
         return self._storage_path + ".pkgs"
 
+    @staticmethod
+    def _valid_pkg_key(key: str) -> bool:
+        # Content-addressed sha1 hex only: the key becomes a FILENAME, so
+        # anything else (e.g. '../..' traversal) must be rejected.
+        return (len(key) == 40
+                and all(c in "0123456789abcdef" for c in key))
+
     def _persist_pkg(self, key: str, value: bytes) -> None:
         import os
+        if not self._valid_pkg_key(key):
+            logger.warning("rejecting non-sha pkg key %r", key[:64])
+            return
         try:
             os.makedirs(self._pkg_dir(), exist_ok=True)
             path = os.path.join(self._pkg_dir(), key)
@@ -709,7 +719,8 @@ class Controller:
 
     async def kv_get(self, ns: str, key: str) -> Optional[bytes]:
         val = self.kv.get(ns, {}).get(key)
-        if val is None and ns == "pkg" and self._storage_path:
+        if val is None and ns == "pkg" and self._storage_path \
+                and self._valid_pkg_key(key):
             import os
             path = os.path.join(self._pkg_dir(), key)
             if os.path.exists(path):
@@ -720,6 +731,12 @@ class Controller:
 
     async def kv_del(self, ns: str, key: str) -> bool:
         self._mark_dirty()
+        if ns == "pkg" and self._storage_path and self._valid_pkg_key(key):
+            import os
+            try:  # the side file must die too or kv_get resurrects it
+                os.unlink(os.path.join(self._pkg_dir(), key))
+            except OSError:
+                pass
         return self.kv.get(ns, {}).pop(key, None) is not None
 
     async def kv_keys(self, ns: str, prefix: str = "") -> list:

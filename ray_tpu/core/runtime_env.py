@@ -20,6 +20,7 @@ import zipfile
 from typing import Any, Dict, List, Optional, Tuple
 
 MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+_uploaded_pkgs: set = set()  # shas this process already shipped
 _EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules"}
 
 
@@ -56,11 +57,20 @@ def upload_packages(cw, runtime_env: Optional[Dict[str, Any]]
     if not runtime_env:
         return runtime_env
     out = dict(runtime_env)
+
     def _put(path: str) -> str:
         sha, blob = package_dir(path)
-        # overwrite=False dedupes re-uploads of the same content.
-        cw._run(cw.controller.call(
-            "kv_put", "pkg", sha, blob, False)).result(120)
+        # Skip the wire transfer entirely when the controller already has
+        # this content (process-local cache + a cheap key probe) — re-
+        # shipping a 100MB zip per actor would swamp the control plane.
+        if sha in _uploaded_pkgs:
+            return sha
+        existing = cw._run(cw.controller.call(
+            "kv_keys", "pkg", sha)).result(30)
+        if sha not in existing:
+            cw._run(cw.controller.call(
+                "kv_put", "pkg", sha, blob, False)).result(120)
+        _uploaded_pkgs.add(sha)
         return sha
 
     if out.get("working_dir"):
@@ -80,19 +90,25 @@ def apply_in_worker(cw, runtime_env: Optional[Dict[str, Any]]) -> None:
     import sys
 
     def _extract(sha: str) -> str:
+        # Atomic: extract to a private temp dir, then rename into place —
+        # concurrent workers sharing the session dir must never re-extract
+        # over files a running actor is reading.
         target = os.path.join(cw.session_dir, "runtime_envs", sha)
-        marker = os.path.join(target, ".ready")
-        if os.path.exists(marker):
+        if os.path.isdir(target):
             return target
         blob = cw._run(cw.controller.call(
             "kv_get", "pkg", sha)).result(120)
         if blob is None:
             raise RuntimeError(f"runtime_env package {sha} missing from KV")
-        os.makedirs(target, exist_ok=True)
+        tmp = f"{target}.tmp-{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
         with zipfile.ZipFile(io.BytesIO(blob)) as z:
-            z.extractall(target)
-        with open(marker, "w") as f:
-            f.write("ok")
+            z.extractall(tmp)
+        try:
+            os.rename(tmp, target)
+        except OSError:  # raced: someone else won; use theirs
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
         return target
 
     if runtime_env.get("working_dir_pkg"):

@@ -1,0 +1,158 @@
+"""Job submission: run driver scripts on the cluster with captured logs.
+
+Analogue of the reference's job layer (reference: python/ray/dashboard/
+modules/job/ — JobManager:job_manager.py spawns a JobSupervisor detached
+actor per job which runs the entrypoint as a subprocess, streams its logs
+to files, and reports status; `ray job submit/status/logs/stop` CLI).
+The supervisor actor here pipes the driver subprocess's output into an
+in-actor buffer; job metadata lives in the controller KV (ns "job").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+PENDING, RUNNING, SUCCEEDED, FAILED, STOPPED = (
+    "PENDING", "RUNNING", "SUCCEEDED", "FAILED", "STOPPED")
+
+
+class JobSupervisor:
+    """One per job: owns the driver subprocess (reference:
+    job_supervisor.py)."""
+
+    def __init__(self, entrypoint: str, controller_addr: str,
+                 env_vars: Optional[Dict[str, str]] = None):
+        import subprocess
+        import threading
+
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = controller_addr
+        # The driver must be able to import the framework (python <script>
+        # puts the SCRIPT's dir on sys.path, not ours) and whatever the
+        # supervisor's worker can import.
+        import ray_tpu as _pkg
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(_pkg.__file__)))
+        extra = [pkg_root, os.getcwd(), env.get("PYTHONPATH", "")]
+        env["PYTHONPATH"] = os.pathsep.join(p for p in extra if p)
+        env.update(env_vars or {})
+        self._status = RUNNING
+        self._logs: List[str] = []
+        self._started = time.time()
+        self._ended: Optional[float] = None
+        self._proc = subprocess.Popen(
+            entrypoint, shell=True, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+        def pump():
+            assert self._proc.stdout is not None
+            for line in self._proc.stdout:
+                self._logs.append(line)
+                if len(self._logs) > 100_000:  # bounded
+                    del self._logs[:50_000]
+            rc = self._proc.wait()
+            self._ended = time.time()
+            if self._status != STOPPED:
+                self._status = SUCCEEDED if rc == 0 else FAILED
+
+        threading.Thread(target=pump, daemon=True, name="job-logs").start()
+
+    async def status(self) -> dict:
+        return {"status": self._status,
+                "start_time": self._started,
+                "end_time": self._ended}
+
+    async def logs(self, tail: Optional[int] = None) -> str:
+        lines = self._logs if tail is None else self._logs[-tail:]
+        return "".join(lines)
+
+    async def stop_job(self) -> str:
+        if self._proc.poll() is None:
+            self._status = STOPPED
+            self._proc.terminate()
+        return self._status
+
+
+def _controller_addr_str() -> str:
+    from ray_tpu import api
+    host, port = api._cw().controller_addr
+    return f"{host}:{port}"
+
+
+def _kv(method: str, *args):
+    from ray_tpu import api
+    cw = api._cw()
+    return cw._run(cw.controller.call(method, *args)).result(30)
+
+
+def submit_job(entrypoint: str, *,
+               submission_id: Optional[str] = None,
+               env_vars: Optional[Dict[str, str]] = None) -> str:
+    """Start `entrypoint` (a shell command) as a cluster job; returns the
+    submission id (reference: JobSubmissionClient.submit_job)."""
+    job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+    supervisor = ray_tpu.remote(JobSupervisor).options(
+        name=f"_job_supervisor:{job_id}").remote(
+        entrypoint, _controller_addr_str(), env_vars)
+    # Surface immediate spawn failures before recording the job.
+    ray_tpu.get(supervisor.status.remote(), timeout=60)
+    _kv("kv_put", "job", job_id, json.dumps({
+        "entrypoint": entrypoint, "submitted_at": time.time()}).encode(),
+        True)
+    return job_id
+
+
+def _supervisor(job_id: str):
+    return ray_tpu.get_actor(f"_job_supervisor:{job_id}")
+
+
+def get_job_status(job_id: str) -> str:
+    try:
+        return ray_tpu.get(_supervisor(job_id).status.remote(),
+                           timeout=30)["status"]
+    except ValueError:
+        meta = _kv("kv_get", "job", job_id)
+        if meta is None:
+            raise ValueError(f"no such job {job_id!r}") from None
+        return FAILED  # supervisor gone without final status
+
+
+def get_job_info(job_id: str) -> dict:
+    meta_raw = _kv("kv_get", "job", job_id)
+    meta = json.loads(meta_raw) if meta_raw else {}
+    try:
+        meta.update(ray_tpu.get(_supervisor(job_id).status.remote(),
+                                timeout=30))
+    except ValueError:
+        meta["status"] = FAILED
+    meta["submission_id"] = job_id
+    return meta
+
+
+def get_job_logs(job_id: str, tail: Optional[int] = None) -> str:
+    return ray_tpu.get(_supervisor(job_id).logs.remote(tail), timeout=30)
+
+
+def stop_job(job_id: str) -> str:
+    return ray_tpu.get(_supervisor(job_id).stop_job.remote(), timeout=30)
+
+
+def list_jobs() -> List[dict]:
+    return [get_job_info(job_id) for job_id in _kv("kv_keys", "job")]
+
+
+def wait_job(job_id: str, timeout: float = 300.0) -> str:
+    """Block until the job reaches a terminal state."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status = get_job_status(job_id)
+        if status in (SUCCEEDED, FAILED, STOPPED):
+            return status
+        time.sleep(0.5)
+    raise TimeoutError(f"job {job_id} still {status} after {timeout}s")

@@ -1,0 +1,11 @@
+"""Public utilities over the actor runtime.
+
+Analogue of the reference's ray.util helpers (reference:
+python/ray/util/actor_pool.py ActorPool, python/ray/util/queue.py Queue —
+an actor-backed distributed queue).
+"""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Empty", "Full", "Queue"]

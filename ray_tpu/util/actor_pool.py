@@ -1,0 +1,87 @@
+"""ActorPool — load-balance tasks over a fixed set of actors.
+
+Analogue of the reference's ActorPool (reference:
+python/ray/util/actor_pool.py — submit(fn, value) round-robins onto free
+actors; get_next/get_next_unordered collect; map/map_unordered stream).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queues when all actors busy."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def _return_actor(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future)
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in SUBMISSION order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(ref)
+        try:
+            return ray_tpu.get(ref, timeout=timeout)
+        finally:
+            self._return_actor(actor)
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next COMPLETED result, any order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        ref = ready[0]
+        index, actor = self._future_to_actor.pop(ref)
+        self._index_to_future.pop(index)
+        try:
+            return ray_tpu.get(ref)
+        finally:
+            self._return_actor(actor)
+
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def push(self, actor: Any) -> None:
+        """Add an idle actor to the pool."""
+        self._return_actor(actor)

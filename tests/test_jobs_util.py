@@ -1,0 +1,95 @@
+"""Job submission + ActorPool + Queue.
+
+Mirrors the reference's coverage (reference: dashboard/modules/job/tests,
+python/ray/tests/test_actor_pool.py, test_queue.py).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(num_nodes=1, resources={"CPU": 8})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_job_submit_runs_driver_against_cluster(cluster, tmp_path):
+    from ray_tpu import job_submission as jobs
+
+    out = tmp_path / "out.txt"
+    script = tmp_path / "driver.py"
+    script.write_text(f"""
+import ray_tpu
+ray_tpu.init()  # connects via RAY_TPU_ADDRESS set by the supervisor
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+result = ray_tpu.get(add.remote(20, 22))
+print("driver result:", result)
+open({str(out)!r}, "w").write(str(result))
+""")
+    job_id = jobs.submit_job(f"python {script}")
+    status = jobs.wait_job(job_id, timeout=180)
+    logs = jobs.get_job_logs(job_id)
+    assert status == "SUCCEEDED", logs
+    assert "driver result: 42" in logs
+    assert out.read_text() == "42"
+    assert any(j["submission_id"] == job_id for j in jobs.list_jobs())
+
+
+def test_job_failure_and_stop(cluster):
+    from ray_tpu import job_submission as jobs
+
+    bad = jobs.submit_job("python -c 'raise SystemExit(3)'")
+    assert jobs.wait_job(bad, timeout=120) == "FAILED"
+
+    slow = jobs.submit_job("sleep 60")
+    time.sleep(0.5)
+    jobs.stop_job(slow)
+    assert jobs.wait_job(slow, timeout=60) == "STOPPED"
+
+
+def test_actor_pool(cluster):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return 2 * x
+
+    from ray_tpu.util import ActorPool
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(10)))
+    assert out == [2 * i for i in range(10)]  # submission order
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                    range(10)))
+    assert out == [2 * i for i in range(10)]
+
+
+def test_queue_blocking_and_timeout(cluster):
+    from ray_tpu.util import Empty, Queue
+    q = Queue(maxsize=4)
+    for i in range(4):
+        q.put(i)
+    assert q.qsize() == 4 and q.full()
+    assert [q.get() for _ in range(4)] == [0, 1, 2, 3]
+    with pytest.raises(Empty):
+        q.get(timeout=0.3)
+
+    # A consumer task long-polls until a producer arrives.
+    @ray_tpu.remote
+    def consume(q):
+        return q.get(timeout=30)
+
+    ref = consume.remote(q)
+    time.sleep(0.3)
+    q.put("hello")
+    assert ray_tpu.get(ref, timeout=60) == "hello"
+    q.shutdown()
