@@ -1,0 +1,190 @@
+"""Autoscaler — demand-driven node scale-up/down over a NodeProvider.
+
+Analogue of the reference's autoscaler v2 (reference: python/ray/
+autoscaler/v2/autoscaler.py Autoscaler.update -> scheduler.py
+ResourceDemandScheduler.schedule bin-packing -> instance_manager/
+reconciling cloud instances; demand aggregated GCS-side by
+gcs_autoscaler_state_manager.cc). Slimmed loop:
+
+  demand  = pending actors + pending PG bundles + recent infeasible leases
+  supply  = alive nodes' total resources
+  scale UP when demand doesn't bin-pack into idle supply (one node per
+  tick, up to max_nodes); scale DOWN nodes fully idle past
+  idle_timeout_s (down to min_nodes).
+
+NodeProvider is the cloud seam (reference: autoscaler node providers);
+LocalNodeProvider spawns agent processes on this host — the fake-multinode
+analogue used by tests and single-host elasticity.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.common import resources_fit, resources_sub
+from ray_tpu.utils import get_logger
+
+logger = get_logger("autoscaler")
+
+
+class NodeProvider:
+    """Cloud seam: create/terminate worker nodes."""
+
+    def create_node(self, resources: Dict[str, float]) -> Any:
+        raise NotImplementedError
+
+    def terminate_node(self, handle: Any) -> None:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Spawns node agents on this host (reference:
+    autoscaler/_private/fake_multi_node)."""
+
+    def __init__(self, controller_addr, session_dir: Optional[str] = None):
+        from ray_tpu.core.node import make_session_dir
+        self._controller_addr = tuple(controller_addr)
+        self._session_dir = session_dir or make_session_dir()
+
+    def create_node(self, resources: Dict[str, float]):
+        from ray_tpu.core.node import start_agent
+        proc, port = start_agent(self._controller_addr, self._session_dir,
+                                 dict(resources))
+        return {"proc": proc, "port": port}
+
+    def terminate_node(self, handle) -> None:
+        proc = handle["proc"] if isinstance(handle, dict) else handle
+        if isinstance(proc, subprocess.Popen) and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+class Autoscaler:
+    def __init__(self, provider: NodeProvider, *,
+                 node_resources: Dict[str, float],
+                 min_nodes: int = 0, max_nodes: int = 4,
+                 idle_timeout_s: float = 30.0,
+                 update_period_s: float = 1.0):
+        """node_resources: the shape of one launchable node (homogeneous
+        node groups; the reference's multi-node-type scheduler is the
+        extension point)."""
+        from ray_tpu import api
+        self._cw = api._cw()
+        self._provider = provider
+        self._node_resources = dict(node_resources)
+        self._min = min_nodes
+        self._max = max_nodes
+        self._idle_timeout = idle_timeout_s
+        self._period = update_period_s
+        self._launched: List[Any] = []   # provider handles
+        self._idle_since: Dict[bytes, float] = {}
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- scheduling math -------------------------------------------------
+    @staticmethod
+    def _bin_packs(demands: List[Dict[str, float]],
+                   free: List[Dict[str, float]]) -> List[Dict[str, float]]:
+        """First-fit-decreasing: returns the demands that DON'T fit."""
+        free = [dict(f) for f in free]
+        unmet = []
+        for d in sorted(demands, key=lambda d: -sum(d.values())):
+            for f in free:
+                if resources_fit(f, d):
+                    resources_sub(f, d)
+                    break
+            else:
+                unmet.append(d)
+        return unmet
+
+    def _state(self) -> dict:
+        return self._cw._run(self._cw.controller.call(
+            "autoscaler_state")).result(30)
+
+    def update(self) -> Optional[str]:
+        """One reconcile tick; returns the action taken (for tests)."""
+        st = self._state()
+        alive = [n for n in st["nodes"] if n["state"] == "ALIVE"]
+        # Correlate launched handles with registered nodes by agent port
+        # so scale-down terminates the node it drained, never a random
+        # launch (and never a node someone else started).
+        node_addr_ports = {}
+        full = self._cw._run(
+            self._cw.controller.call("get_nodes")).result(30)
+        for n in full:
+            node_addr_ports[n["node_id"]] = n["addr"][1]
+        handles_by_port = {h["port"]: h for h in self._launched
+                          if isinstance(h, dict)}
+        demands = (st["pending_actors"] + st["pending_pg_bundles"]
+                   + st["infeasible"])
+        demands = [d for d in demands if d]
+        unmet = self._bin_packs(demands, [n["available"] for n in alive])
+        if unmet and len(alive) < self._max:
+            # One node per tick (the reference batches; conservative here).
+            fits_new = self._bin_packs(unmet, [self._node_resources])
+            if len(fits_new) < len(unmet):
+                logger.info("scaling UP (+1 node) for %d unmet demands",
+                            len(unmet))
+                self._launched.append(
+                    self._provider.create_node(self._node_resources))
+                return "up"
+            logger.warning("demand %s does not fit node shape %s",
+                           unmet[:3], self._node_resources)
+        # Scale down: nodes with zero usage for idle_timeout_s.
+        if len(alive) > self._min and len(self._launched) > 0:
+            now = time.time()
+            for n in alive:
+                nid = n["node_id"]
+                busy = any(n["available"].get(k, 0) < v - 1e-9
+                           for k, v in n["total"].items())
+                if busy or demands:
+                    self._idle_since.pop(nid, None)
+                    continue
+                handle = handles_by_port.get(node_addr_ports.get(nid))
+                if handle is None:
+                    continue  # not one of ours: never terminate it
+                first = self._idle_since.setdefault(nid, now)
+                if now - first > self._idle_timeout:
+                    # Drain via the controller, terminate via provider.
+                    try:
+                        self._cw._run(self._cw.controller.call(
+                            "drain_node", nid)).result(30)
+                    except Exception:
+                        pass
+                    self._launched.remove(handle)
+                    self._provider.terminate_node(handle)
+                    self._idle_since.pop(nid, None)
+                    logger.info("scaled DOWN one idle node")
+                    return "down"
+        return None
+
+    # -- loop ------------------------------------------------------------
+    def start(self) -> "Autoscaler":
+        self._running = True
+
+        def loop():
+            while self._running:
+                try:
+                    self.update()
+                except Exception as e:
+                    logger.debug("autoscaler tick failed: %r", e)
+                time.sleep(self._period)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=5)
+        for handle in self._launched:
+            self._provider.terminate_node(handle)
+        self._launched.clear()

@@ -122,6 +122,20 @@ def cmd_stop(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    _connect(args.address)
+    import signal
+
+    from ray_tpu.dashboard import start_dashboard
+    dash = start_dashboard(port=args.port)
+    print(f"dashboard at http://127.0.0.1:{dash.port}/")
+    try:
+        signal.pause()
+    except KeyboardInterrupt:
+        dash.stop()
+    return 0
+
+
 def cmd_job(args) -> int:
     _connect(args.address)
     from ray_tpu import job_submission as jobs
@@ -172,6 +186,11 @@ def main(argv=None) -> int:
     sp.add_argument("--address", required=True)
     sp.add_argument("--out", default="timeline.json")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")
+    sp.add_argument("--address", required=True)
+    sp.add_argument("--port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("job", help="submit/inspect cluster jobs")
     sp.add_argument("action",

@@ -100,6 +100,7 @@ class Controller:
         from collections import deque
         self.task_events: "deque" = deque(maxlen=50000)
         self.node_metrics: Dict[str, dict] = {}
+        self._infeasible: "deque" = deque(maxlen=1000)
         # Persistence (reference: gcs/store_client/redis_store_client.cc +
         # gcs_init_data.cc rebuild-on-restart). A snapshot file holds the
         # durable tables: KV (function table!), actors, named actors, PGs,
@@ -408,8 +409,35 @@ class Controller:
         exclude = set(exclude) if exclude else None
         node = self._pick(resources, exclude, strategy)
         if node is None:
+            # Unsatisfiable demand: the autoscaler's scale-up signal
+            # (reference: gcs_autoscaler_state_manager.cc aggregates
+            # pending demand for autoscaler v2).
+            self._infeasible.append((time.time(), dict(resources)))
             return None
         return {"node_id": node.node_id, "addr": node.addr}
+
+    async def autoscaler_state(self) -> dict:
+        """Demand + supply snapshot for the autoscaler (reference:
+        autoscaler/v2 reads GCS autoscaler state)."""
+        now = time.time()
+        infeasible = [r for ts, r in self._infeasible
+                      if now - ts < 30.0]
+        pending_actors = [a.resources for a in self.actors.values()
+                          if a.state in (ActorState.PENDING,
+                                         ActorState.RESTARTING)]
+        pending_pg_bundles = [b for pg in self.pgs.values()
+                              if pg.state == PGState.PENDING
+                              for b in pg.bundles]
+        return {
+            "infeasible": infeasible,
+            "pending_actors": pending_actors,
+            "pending_pg_bundles": pending_pg_bundles,
+            "nodes": [{
+                "node_id": n.node_id, "state": n.state,
+                "total": n.resources_total,
+                "available": n.resources_available,
+            } for n in self.nodes.values()],
+        }
 
     # ------------------------------------------------------------------
     # actors

@@ -1,0 +1,104 @@
+"""Dashboard-lite: an HTTP view over the state API + metrics.
+
+Analogue of the reference's dashboard head (reference: python/ray/
+dashboard/ — aiohttp head serving /api/... + Prometheus metrics; the
+React client is out of scope). Endpoints:
+
+    GET /                -> minimal HTML overview
+    GET /api/summary     -> cluster summary JSON
+    GET /api/nodes|actors|tasks|workers|jobs
+    GET /metrics         -> Prometheus text exposition
+
+Run via `python -m ray_tpu.cli dashboard --address H:P [--port 8265]`
+or `start_dashboard(...)` in a driver.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>body{{font-family:monospace;margin:2em}}pre{{background:#f4f4f4;
+padding:1em}}</style></head>
+<body><h2>ray_tpu cluster</h2>
+<pre id="summary">loading...</pre>
+<h3>endpoints</h3>
+<ul><li><a href="/api/summary">/api/summary</a></li>
+<li><a href="/api/nodes">/api/nodes</a></li>
+<li><a href="/api/actors">/api/actors</a></li>
+<li><a href="/api/tasks">/api/tasks</a></li>
+<li><a href="/api/workers">/api/workers</a></li>
+<li><a href="/api/jobs">/api/jobs</a></li>
+<li><a href="/metrics">/metrics</a></li></ul>
+<script>fetch('/api/summary').then(r=>r.json()).then(d=>
+document.getElementById('summary').textContent=
+JSON.stringify(d,null,2));</script>
+</body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, status: int, body: bytes,
+              ctype: str = "application/json") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        from ray_tpu import state
+        try:
+            if self.path == "/" or self.path == "/index.html":
+                self._send(200, _PAGE.encode(), "text/html")
+                return
+            if self.path == "/metrics":
+                self._send(200, state.metrics_text().encode(),
+                           "text/plain; version=0.0.4")
+                return
+            routes = {
+                "/api/summary": state.cluster_summary,
+                "/api/nodes": state.list_nodes,
+                "/api/actors": state.list_actors,
+                "/api/tasks": state.list_tasks,
+                "/api/workers": state.list_workers,
+            }
+            if self.path == "/api/jobs":
+                from ray_tpu import job_submission
+                self._send(200, json.dumps(job_submission.list_jobs(),
+                                           default=str).encode())
+                return
+            fn = routes.get(self.path)
+            if fn is None:
+                self._send(404, b'{"error": "not found"}')
+                return
+            self._send(200, json.dumps(fn(), default=str).encode())
+        except Exception as e:  # pragma: no cover - defensive
+            self._send(500, json.dumps({"error": repr(e)}).encode())
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="dashboard")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._thread.join(timeout=5)
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 8265) -> Dashboard:
+    """Serve the dashboard over the CURRENT driver connection
+    (ray_tpu.init must have been called)."""
+    return Dashboard(host, port)

@@ -46,9 +46,13 @@ class JobSupervisor:
         self._logs: List[str] = []
         self._started = time.time()
         self._ended: Optional[float] = None
+        # New session => own process group: stop_job must kill the whole
+        # entrypoint tree, not just the shell (reference: job_supervisor
+        # start_new_session + group kill).
         self._proc = subprocess.Popen(
-            entrypoint, shell=True, env=env,
+            entrypoint, shell=True, env=env, start_new_session=True,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        self._job_id = env_vars.get("_JOB_ID", "") if env_vars else ""
 
         def pump():
             assert self._proc.stdout is not None
@@ -60,8 +64,31 @@ class JobSupervisor:
             self._ended = time.time()
             if self._status != STOPPED:
                 self._status = SUCCEEDED if rc == 0 else FAILED
+            self._persist_final()
 
         threading.Thread(target=pump, daemon=True, name="job-logs").start()
+
+    def _persist_final(self) -> None:
+        """Record the terminal status + a log tail in the controller KV
+        so job info outlives this supervisor actor."""
+        try:
+            import json as _json
+
+            from ray_tpu import api
+            cw = api._cw()
+            cw._run(cw.controller.call(
+                "kv_put", "job_status", self._job_id or "unknown",
+                _json.dumps({
+                    "status": self._status,
+                    "start_time": self._started,
+                    "end_time": self._ended,
+                }).encode(), True)).result(30)
+            tail = "".join(self._logs[-2000:])[-1_000_000:]
+            cw._run(cw.controller.call(
+                "kv_put", "job_logs", self._job_id or "unknown",
+                tail.encode(errors="replace"), True)).result(30)
+        except Exception:
+            pass
 
     async def status(self) -> dict:
         return {"status": self._status,
@@ -75,7 +102,11 @@ class JobSupervisor:
     async def stop_job(self) -> str:
         if self._proc.poll() is None:
             self._status = STOPPED
-            self._proc.terminate()
+            import signal
+            try:  # kill the whole process group (shell + children)
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+            except Exception:
+                self._proc.terminate()
         return self._status
 
 
@@ -97,6 +128,8 @@ def submit_job(entrypoint: str, *,
     """Start `entrypoint` (a shell command) as a cluster job; returns the
     submission id (reference: JobSubmissionClient.submit_job)."""
     job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+    env_vars = dict(env_vars or {})
+    env_vars["_JOB_ID"] = job_id
     supervisor = ray_tpu.remote(JobSupervisor).options(
         name=f"_job_supervisor:{job_id}").remote(
         entrypoint, _controller_addr_str(), env_vars)
@@ -117,10 +150,14 @@ def get_job_status(job_id: str) -> str:
         return ray_tpu.get(_supervisor(job_id).status.remote(),
                            timeout=30)["status"]
     except ValueError:
+        # Supervisor gone: the terminal status was persisted to the KV.
+        final = _kv("kv_get", "job_status", job_id)
+        if final is not None:
+            return json.loads(final)["status"]
         meta = _kv("kv_get", "job", job_id)
         if meta is None:
             raise ValueError(f"no such job {job_id!r}") from None
-        return FAILED  # supervisor gone without final status
+        return FAILED  # died before reaching a terminal state
 
 
 def get_job_info(job_id: str) -> dict:
@@ -130,13 +167,38 @@ def get_job_info(job_id: str) -> dict:
         meta.update(ray_tpu.get(_supervisor(job_id).status.remote(),
                                 timeout=30))
     except ValueError:
-        meta["status"] = FAILED
+        final = _kv("kv_get", "job_status", job_id)
+        meta.update(json.loads(final) if final else {"status": FAILED})
     meta["submission_id"] = job_id
     return meta
 
 
 def get_job_logs(job_id: str, tail: Optional[int] = None) -> str:
-    return ray_tpu.get(_supervisor(job_id).logs.remote(tail), timeout=30)
+    try:
+        return ray_tpu.get(_supervisor(job_id).logs.remote(tail),
+                           timeout=30)
+    except ValueError:
+        blob = _kv("kv_get", "job_logs", job_id)
+        if blob is None:
+            raise ValueError(f"no logs for job {job_id!r}") from None
+        text = blob.decode(errors="replace")
+        if tail is not None:
+            text = "".join(text.splitlines(keepends=True)[-tail:])
+        return text
+
+
+def delete_job(job_id: str) -> None:
+    """Tear down a finished job's supervisor + metadata (supervisors
+    otherwise stay resident to serve live logs)."""
+    try:
+        ray_tpu.kill(_supervisor(job_id))
+    except Exception:
+        pass
+    for ns in ("job", "job_status", "job_logs"):
+        try:
+            _kv("kv_del", ns, job_id)
+        except Exception:
+            pass
 
 
 def stop_job(job_id: str) -> str:
@@ -150,9 +212,12 @@ def list_jobs() -> List[dict]:
 def wait_job(job_id: str, timeout: float = 300.0) -> str:
     """Block until the job reaches a terminal state."""
     deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        status = get_job_status(job_id)
+    status = get_job_status(job_id)
+    while True:
         if status in (SUCCEEDED, FAILED, STOPPED):
             return status
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"job {job_id} still {status} after {timeout}s")
         time.sleep(0.5)
-    raise TimeoutError(f"job {job_id} still {status} after {timeout}s")
+        status = get_job_status(job_id)

@@ -41,14 +41,21 @@ class ActorPool:
         return bool(self._index_to_future)
 
     def get_next(self, timeout: Optional[float] = None) -> Any:
-        """Next result in SUBMISSION order."""
+        """Next result in SUBMISSION order. On timeout the task stays
+        pending and its actor stays busy (popping before the result is
+        ready would lose the result and double-book the actor)."""
         if not self.has_next():
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(self._next_return_index)
+        ref = self._index_to_future[self._next_return_index]
+        if timeout is not None:
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+            if not ready:
+                raise TimeoutError("next result not ready within timeout")
+        self._index_to_future.pop(self._next_return_index)
         self._next_return_index += 1
         _, actor = self._future_to_actor.pop(ref)
         try:
-            return ray_tpu.get(ref, timeout=timeout)
+            return ray_tpu.get(ref)
         finally:
             self._return_actor(actor)
 

@@ -1,0 +1,76 @@
+"""Autoscaler: demand-driven scale-up, idle scale-down, bin-packing.
+
+Mirrors the reference's fake-multinode autoscaler tests (reference:
+python/ray/tests/test_autoscaler_fake_multinode.py — the full loop with
+local 'cloud' nodes).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider
+from ray_tpu.core.cluster_utils import Cluster
+
+
+def test_bin_packing():
+    unmet = Autoscaler._bin_packs(
+        [{"CPU": 2.0}, {"CPU": 2.0}, {"CPU": 1.0}],
+        [{"CPU": 2.0}, {"CPU": 2.0}])
+    assert unmet == [{"CPU": 1.0}]
+    assert Autoscaler._bin_packs([{"CPU": 1.0}], [{"CPU": 4.0}]) == []
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(num_nodes=1, resources={"CPU": 2})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_scale_up_then_down(cluster):
+    from ray_tpu import api
+    cw = api._cw()
+    provider = LocalNodeProvider(cw.controller_addr)
+    scaler = Autoscaler(provider, node_resources={"CPU": 2},
+                        min_nodes=1, max_nodes=3, idle_timeout_s=3.0,
+                        update_period_s=0.5)
+
+    @ray_tpu.remote(num_cpus=2)
+    class Big:
+        def where(self):
+            import os
+            return os.getpid()
+
+    try:
+        # 3 two-CPU actors cannot fit on the single 2-CPU node.
+        actors = [Big.remote() for _ in range(3)]
+        scaler.start()
+        deadline = time.monotonic() + 120
+        pids = None
+        while time.monotonic() < deadline:
+            try:
+                pids = ray_tpu.get([a.where.remote() for a in actors],
+                                   timeout=10)
+                break
+            except Exception:
+                time.sleep(1.0)
+        assert pids is not None, "actors never all scheduled (no scale-up)"
+        assert len(set(pids)) == 3
+        alive = [n for n in ray_tpu.nodes() if n["state"] == "ALIVE"]
+        assert len(alive) >= 2, "autoscaler never added nodes"
+
+        # Free the demand; launched nodes become idle and are culled.
+        for a in actors:
+            ray_tpu.kill(a)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["state"] == "ALIVE"]
+            if len(alive) == 1:
+                break
+            time.sleep(1.0)
+        assert len(alive) == 1, f"never scaled back down: {len(alive)}"
+    finally:
+        scaler.stop()

@@ -1,0 +1,58 @@
+"""Dashboard-lite HTTP endpoints."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+from ray_tpu.dashboard import start_dashboard
+
+
+@pytest.fixture(scope="module")
+def dash():
+    c = Cluster(num_nodes=1, resources={"CPU": 4})
+    c.connect()
+    d = start_dashboard(port=0)
+    yield d
+    d.stop()
+    c.shutdown()
+
+
+def _get(dash, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{dash.port}{path}", timeout=30) as r:
+        return r.status, r.read()
+
+
+def test_endpoints(dash):
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return 1
+
+    m = Marker.remote()
+    ray_tpu.get(m.ping.remote())
+
+    status, body = _get(dash, "/api/summary")
+    assert status == 200
+    assert json.loads(body)["nodes_alive"] == 1
+
+    status, body = _get(dash, "/api/nodes")
+    assert json.loads(body)[0]["state"] == "ALIVE"
+
+    status, body = _get(dash, "/api/actors")
+    assert any(a["state"] == "ALIVE" for a in json.loads(body))
+
+    status, body = _get(dash, "/")
+    assert status == 200 and b"ray_tpu cluster" in body
+
+    status, body = _get(dash, "/metrics")
+    assert status == 200
+
+    try:
+        _get(dash, "/api/nope")
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
