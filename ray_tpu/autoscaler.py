@@ -31,13 +31,21 @@ logger = get_logger("autoscaler")
 
 
 class NodeProvider:
-    """Cloud seam: create/terminate worker nodes."""
+    """Cloud seam: create/terminate worker nodes.
+
+    node_port(handle) is the scale-down correlation key: the agent RPC
+    port of the launched node (the autoscaler only terminates nodes it
+    can correlate to a handle; returning None opts a node out of
+    scale-down)."""
 
     def create_node(self, resources: Dict[str, float]) -> Any:
         raise NotImplementedError
 
     def terminate_node(self, handle: Any) -> None:
         raise NotImplementedError
+
+    def node_port(self, handle: Any) -> Optional[int]:
+        return None
 
 
 class LocalNodeProvider(NodeProvider):
@@ -54,6 +62,9 @@ class LocalNodeProvider(NodeProvider):
         proc, port = start_agent(self._controller_addr, self._session_dir,
                                  dict(resources))
         return {"proc": proc, "port": port}
+
+    def node_port(self, handle) -> Optional[int]:
+        return handle["port"]
 
     def terminate_node(self, handle) -> None:
         proc = handle["proc"] if isinstance(handle, dict) else handle
@@ -119,8 +130,11 @@ class Autoscaler:
             self._cw.controller.call("get_nodes")).result(30)
         for n in full:
             node_addr_ports[n["node_id"]] = n["addr"][1]
-        handles_by_port = {h["port"]: h for h in self._launched
-                          if isinstance(h, dict)}
+        handles_by_port = {}
+        for h in self._launched:
+            port = self._provider.node_port(h)
+            if port is not None:
+                handles_by_port[port] = h
         demands = (st["pending_actors"] + st["pending_pg_bundles"]
                    + st["infeasible"])
         demands = [d for d in demands if d]

@@ -100,7 +100,11 @@ class Controller:
         from collections import deque
         self.task_events: "deque" = deque(maxlen=50000)
         self.node_metrics: Dict[str, dict] = {}
-        self._infeasible: "deque" = deque(maxlen=1000)
+        # Infeasible-demand signals, coalesced BY SHAPE (a parked lease
+        # retries pick_node every ~250ms; raw per-attempt records would
+        # multiply one pending task into dozens of demands and stampede
+        # the autoscaler).
+        self._infeasible: Dict[tuple, tuple] = {}
         # Persistence (reference: gcs/store_client/redis_store_client.cc +
         # gcs_init_data.cc rebuild-on-restart). A snapshot file holds the
         # durable tables: KV (function table!), actors, named actors, PGs,
@@ -229,6 +233,10 @@ class Controller:
         """Prometheus text exposition over every node's registry."""
         from ray_tpu.utils.metrics import render_prometheus
         return render_prometheus(self.node_metrics)
+
+    async def publish_logs(self, events: list) -> None:
+        for ev in events:
+            self.pubsub.publish("log_events", ev)
 
     async def report_task_events(self, events: list) -> None:
         self.task_events.extend(events)
@@ -412,7 +420,8 @@ class Controller:
             # Unsatisfiable demand: the autoscaler's scale-up signal
             # (reference: gcs_autoscaler_state_manager.cc aggregates
             # pending demand for autoscaler v2).
-            self._infeasible.append((time.time(), dict(resources)))
+            key = tuple(sorted(resources.items()))
+            self._infeasible[key] = (time.time(), dict(resources))
             return None
         return {"node_id": node.node_id, "addr": node.addr}
 
@@ -420,8 +429,11 @@ class Controller:
         """Demand + supply snapshot for the autoscaler (reference:
         autoscaler/v2 reads GCS autoscaler state)."""
         now = time.time()
-        infeasible = [r for ts, r in self._infeasible
+        infeasible = [r for ts, r in self._infeasible.values()
                       if now - ts < 30.0]
+        for key, (ts, _) in list(self._infeasible.items()):
+            if now - ts >= 30.0:
+                self._infeasible.pop(key, None)
         pending_actors = [a.resources for a in self.actors.values()
                           if a.state in (ActorState.PENDING,
                                          ActorState.RESTARTING)]

@@ -200,6 +200,18 @@ class CoreWorker:
         self.node_id = reply["node_id"]
         self.store_dir = reply["store_dir"]
         spawn(self._task_event_flusher())
+        if self.mode == "driver" and GlobalConfig.log_to_driver:
+            # Worker prints stream to this driver (reference:
+            # worker.py:2261 print_worker_logs).
+            from ray_tpu.core.pubsub import Subscription
+
+            def _print_log(ev: dict) -> None:
+                print(f"(pid={ev['pid']}, node={ev['node']}) {ev['line']}",
+                      flush=True)
+
+            self._log_sub = Subscription(
+                self.controller, "log_events", _print_log,
+                from_latest=True).start()
 
     @property
     def address(self) -> Address:

@@ -311,12 +311,55 @@ class NodeAgent:
                     env.pop(str(k), None)
                 else:
                     env[str(k)] = str(v)
+        capture = GlobalConfig.log_to_driver
+        if capture:
+            # Piped stdout would otherwise block-buffer: prints inside
+            # tasks must reach the driver promptly.
+            env["PYTHONUNBUFFERED"] = "1"
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env, cwd=os.getcwd())
+            env=env, cwd=os.getcwd(),
+            stdout=subprocess.PIPE if capture else None,
+            stderr=subprocess.STDOUT if capture else None,
+            text=capture or None)
         w = WorkerProc(proc, b"")
         self._pending_registration[proc.pid] = w
+        if capture:
+            self._start_log_pump(proc)
         return w
+
+    def _start_log_pump(self, proc) -> None:
+        """Forward the worker's stdout/stderr lines to the controller's
+        log_events pubsub channel (reference: _private/log_monitor.py
+        tailing + worker.py print_worker_logs on the driver)."""
+        import threading
+
+        loop = asyncio.get_running_loop()
+
+        async def _publish(lines):
+            try:
+                await self.controller.call("publish_logs", [
+                    {"pid": proc.pid, "node": self.node_id.hex()[:8],
+                     "line": ln} for ln in lines])
+            except Exception:
+                pass
+
+        def pump():
+            # Publish per line, AWAITING each RPC: the pump thread then
+            # drains at controller speed and the pipe back-pressures a
+            # fast-printing worker (fire-and-forget would queue unbounded
+            # coroutines). A time-batched flush is wrong here — it would
+            # strand trailing lines until the NEXT line arrives.
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        _publish([line.rstrip("\n")]), loop).result(10)
+                except Exception:
+                    pass
+
+        threading.Thread(target=pump, daemon=True,
+                         name=f"logpump-{proc.pid}").start()
 
     async def register_worker(self, worker_id: bytes, pid: int, port: int) -> dict:
         w = self._pending_registration.pop(pid, None)

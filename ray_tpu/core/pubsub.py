@@ -74,6 +74,12 @@ class PubsubHub:
         until a publish or the timeout. Reply shape:
         {"events": [...], "next_seq": int, "gap": bool}"""
         events, nxt, gap = self._collect(channel, from_seq)
+        if from_seq < 0:
+            # Cursor fetch ("subscribe from latest") must NOT park:
+            # anything published while parked would fall between the
+            # returned cursor and the events the parked poll discards.
+            return {"events": [], "next_seq": nxt, "gap": False,
+                    "epoch": self.epoch}
         if not events:
             ev = asyncio.Event()
             self._waiters.setdefault(channel, []).append(ev)

@@ -185,6 +185,11 @@ class ActorMethod:
         return get_core_worker().submit_actor_task(
             self._handle, self._method, args, kwargs)
 
+    def bind(self, *args: Any, **kwargs: Any):
+        """Lazy DAG node (reference: dag_node bind API)."""
+        from ray_tpu.dag import ClassMethodNode
+        return ClassMethodNode(self._handle, self._method, args, kwargs)
+
     def options(self, **opts):
         handle, method = self._handle, self._method
 

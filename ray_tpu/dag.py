@@ -1,0 +1,139 @@
+"""Compiled actor DAGs (aDAG-lite).
+
+Analogue of the reference's compiled graphs (reference: python/ray/dag/ —
+dag_node.py lazy nodes, input_node.py InputNode, output_node.py
+MultiOutputNode, compiled_dag_node.py CompiledDAG:805 with NCCL channels
+and overlap scheduling). TPU-lite redesign: the lazy ``bind`` API is kept
+verbatim; compilation topologically sorts the graph ONCE and replays it
+per execute() with direct pipelined actor pushes and ObjectRef plumbing —
+activation handoffs between actors ride the runtime's direct
+worker-to-worker object path instead of NCCL channels (intra-host shm;
+the ICI device-channel fast path is device_objects.DeviceRef). For
+in-graph device-to-device tensors, combine with
+``ray_tpu.device_objects`` refs as values.
+
+    with InputNode() as inp:
+        x = preproc.run.bind(inp)
+        y = model.forward.bind(x)
+        dag = MultiOutputNode([y, postproc.run.bind(y)])
+    compiled = dag.experimental_compile()
+    out_refs = compiled.execute(batch)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class DAGNode:
+    def __init__(self):
+        self._upstream: List["DAGNode"] = []
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+    def execute(self, *args) -> Any:
+        """Eager one-shot execution (compiles a throwaway plan)."""
+        return CompiledDAG(self).execute(*args)
+
+
+class InputNode(DAGNode):
+    """The DAG's input placeholder (reference: input_node.py). Usable as
+    a context manager purely for the reference's familiar spelling — the
+    graph edges come from passing the node into bind()."""
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+class ClassMethodNode(DAGNode):
+    """One actor-method invocation in the graph (reference:
+    dag/class_node.py ClassMethodNode)."""
+
+    def __init__(self, actor, method_name: str, args: tuple, kwargs: dict):
+        super().__init__()
+        self.actor = actor
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, DAGNode):
+                self._upstream.append(a)
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__()
+        self.outputs = list(outputs)
+        self._upstream = list(outputs)
+
+
+class _BoundMethod:
+    def __init__(self, actor, name: str):
+        self._actor = actor
+        self._name = name
+
+    def bind(self, *args, **kwargs) -> ClassMethodNode:
+        return ClassMethodNode(self._actor, self._name, args, kwargs)
+
+
+def bind_method(actor, method_name: str) -> _BoundMethod:
+    """`actor.method.bind(...)` sugar lives on ActorMethod; this is the
+    functional spelling."""
+    return _BoundMethod(actor, method_name)
+
+
+class CompiledDAG:
+    """Topologically-sorted replayable plan (reference:
+    compiled_dag_node.py CompiledDAG — ours replays direct actor pushes;
+    the runtime already pipelines and ships refs worker-to-worker)."""
+
+    def __init__(self, root: DAGNode):
+        self._root = root
+        self._order: List[DAGNode] = []
+        self._input: Optional[InputNode] = None
+        self._toposort(root, set())
+        for node in self._order:
+            if isinstance(node, InputNode):
+                if self._input is not None and self._input is not node:
+                    raise ValueError("a DAG supports one InputNode")
+                self._input = node
+
+    def _toposort(self, node: DAGNode, seen: set) -> None:
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for up in node._upstream:
+            self._toposort(up, seen)
+        self._order.append(node)
+
+    def execute(self, *args) -> Any:
+        """Run the plan; returns the ObjectRef of the root node (or a
+        list of refs for MultiOutputNode). Intermediate results flow as
+        ObjectRefs straight between the actors."""
+        if self._input is not None:
+            if len(args) != 1:
+                raise TypeError(
+                    f"DAG takes exactly 1 input, got {len(args)}")
+        values: Dict[int, Any] = {}
+        for node in self._order:
+            if isinstance(node, InputNode):
+                values[id(node)] = args[0]
+            elif isinstance(node, ClassMethodNode):
+                call_args = [values[id(a)] if isinstance(a, DAGNode) else a
+                             for a in node.args]
+                call_kwargs = {k: values[id(v)] if isinstance(v, DAGNode)
+                               else v for k, v in node.kwargs.items()}
+                method = getattr(node.actor, node.method_name)
+                values[id(node)] = method.remote(*call_args, **call_kwargs)
+            elif isinstance(node, MultiOutputNode):
+                values[id(node)] = [values[id(o)] for o in node.outputs]
+            else:
+                raise TypeError(f"unknown DAG node {type(node).__name__}")
+        return values[id(self._root)]
+
+    def teardown(self) -> None:
+        pass  # no channel resources to release in the ref-based plan

@@ -22,8 +22,8 @@ from typing import Optional
 
 _PAGE = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
-<style>body{{font-family:monospace;margin:2em}}pre{{background:#f4f4f4;
-padding:1em}}</style></head>
+<style>body{font-family:monospace;margin:2em}pre{background:#f4f4f4;
+padding:1em}</style></head>
 <body><h2>ray_tpu cluster</h2>
 <pre id="summary">loading...</pre>
 <h3>endpoints</h3>

@@ -95,6 +95,7 @@ _flag("tpu_visible_chips", str, "", "Analogue of TPU_VISIBLE_CHIPS pinning.")
 _flag("collective_cpu_fallback", bool, True, "Allow CPU fallback collectives when no TPU present.")
 
 # --- logging / observability ---
+_flag("log_to_driver", bool, True, "Stream worker stdout/stderr lines to the driver via the controller log_events channel.")
 _flag("event_stats_enabled", bool, True, "Record per-handler event-loop stats.")
 _flag("task_events_batch_size", int, 1000, "Task events per batch sent to controller.")
 _flag("metrics_report_period_ms", int, 5000, "Metrics push period.")

@@ -1,0 +1,65 @@
+"""Compiled actor DAGs: bind/execute, multi-actor pipelines, fan-out.
+
+Mirrors the reference's compiled-graph basics (reference:
+python/ray/dag/tests/experimental/test_accelerated_dag.py core cases,
+minus the NCCL channel machinery)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(num_nodes=1, resources={"CPU": 8})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+@ray_tpu.remote
+class Stage:
+    def __init__(self, add):
+        self.add = add
+
+    def run(self, x):
+        return x + self.add
+
+    def mul(self, x, y):
+        return x * y
+
+
+def test_two_stage_pipeline(cluster):
+    a, b = Stage.remote(1), Stage.remote(10)
+    with InputNode() as inp:
+        dag = b.run.bind(a.run.bind(inp))
+    compiled = dag.experimental_compile()
+    for x in range(5):
+        assert ray_tpu.get(compiled.execute(x)) == x + 11  # (+1) then (+10)
+
+
+def test_fan_out_multi_output(cluster):
+    a, b, c = Stage.remote(1), Stage.remote(2), Stage.remote(3)
+    with InputNode() as inp:
+        shared = a.run.bind(inp)
+        dag = MultiOutputNode([b.run.bind(shared), c.run.bind(shared)])
+    refs = dag.experimental_compile().execute(10)
+    assert ray_tpu.get(refs) == [13, 14]  # 10+1 then +2 / +3
+
+
+def test_multi_arg_and_constants(cluster):
+    a = Stage.remote(0)
+    with InputNode() as inp:
+        dag = a.mul.bind(a.run.bind(inp), 7)
+    assert ray_tpu.get(dag.execute(6)) == 42
+
+
+def test_compiled_replay_is_reusable(cluster):
+    a = Stage.remote(5)
+    with InputNode() as inp:
+        dag = a.run.bind(inp)
+    compiled = dag.experimental_compile()
+    outs = [ray_tpu.get(compiled.execute(i)) for i in range(20)]
+    assert outs == [i + 5 for i in range(20)]

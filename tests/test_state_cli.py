@@ -86,6 +86,25 @@ def test_metrics_pipeline(cluster):
     assert "# TYPE raytpu_workers gauge" in text
 
 
+def test_worker_prints_stream_to_driver(cluster, capfd):
+    @ray_tpu.remote
+    def chatty(i):
+        print(f"hello-from-task-{i}")
+        return i
+
+    assert ray_tpu.get([chatty.remote(i) for i in range(3)]) == [0, 1, 2]
+    deadline = time.monotonic() + 20
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capfd.readouterr().out
+        if all(f"hello-from-task-{i}" in seen for i in range(3)):
+            break
+        time.sleep(0.25)
+    for i in range(3):
+        assert f"hello-from-task-{i}" in seen, seen[-2000:]
+    assert "(pid=" in seen  # driver prefixes worker output
+
+
 def test_cli_status_and_list(cluster):
     from ray_tpu import api
     host, port = api._cw().controller_addr
