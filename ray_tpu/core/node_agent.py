@@ -154,6 +154,8 @@ class NodeAgent:
         spawn(self._heartbeat_loop())
         spawn(self._reap_loop())
         spawn(self._metrics_loop())
+        if GlobalConfig.memory_monitor_refresh_ms > 0:
+            spawn(self._memory_monitor_loop())
         # Cluster membership via controller pubsub (reference: raylets
         # subscribe to GCS node-info channel, not direct RPC pushes).
         self._node_sub = Subscription(
@@ -219,6 +221,66 @@ class NodeAgent:
                     M.snapshot_all())
             except Exception as e:
                 logger.debug("metrics push failed: %r", e)
+
+    # ------------------------------------------------------------------
+    # memory monitor + OOM killing (reference: src/ray/common/
+    # memory_monitor.h polls /proc; raylet/worker_killing_policy_
+    # retriable_fifo.cc picks the newest retriable work first)
+    # ------------------------------------------------------------------
+    def _memory_usage_fraction(self) -> float:
+        test_file = GlobalConfig.memory_monitor_test_file
+        if test_file:
+            try:
+                with open(test_file) as f:
+                    return float(f.read().strip())
+            except Exception:
+                return 0.0
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, rest = line.partition(":")
+                    info[k] = int(rest.strip().split()[0])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", total)
+            return 1.0 - avail / total if total else 0.0
+        except Exception:
+            return 0.0
+
+    async def _memory_monitor_loop(self) -> None:
+        period = GlobalConfig.memory_monitor_refresh_ms / 1000
+        threshold = GlobalConfig.memory_usage_threshold
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            usage = self._memory_usage_fraction()
+            if usage <= threshold:
+                continue
+            victim = self._pick_oom_victim()
+            if victim is None:
+                continue
+            logger.warning(
+                "node memory %.0f%% > %.0f%%: killing worker pid=%s "
+                "(its tasks are retriable)", usage * 100, threshold * 100,
+                getattr(victim.proc, "pid", "?"))
+            self.num_oom_kills = getattr(self, "num_oom_kills", 0) + 1
+            try:
+                victim.proc.terminate()
+            except Exception:
+                pass
+
+    def _pick_oom_victim(self) -> Optional[WorkerProc]:
+        """Newest LEASED task worker first (retriable-FIFO): its task
+        retries; dedicated actor workers only as a last resort (actor
+        restarts are scarcer), external procs never."""
+        leased = [w for w in self.workers.values()
+                  if w.current_lease is not None
+                  and isinstance(w.proc, subprocess.Popen)]
+        if leased:
+            return max(leased, key=lambda w: w.proc.pid)
+        actors = [w for w in self.workers.values()
+                  if w.dedicated_actor is not None
+                  and isinstance(w.proc, subprocess.Popen)]
+        return max(actors, key=lambda w: w.proc.pid) if actors else None
 
     async def _reap_loop(self) -> None:
         """Monitor child worker processes; clean up on death; retire idle
@@ -321,7 +383,8 @@ class NodeAgent:
             env=env, cwd=os.getcwd(),
             stdout=subprocess.PIPE if capture else None,
             stderr=subprocess.STDOUT if capture else None,
-            text=capture or None)
+            text=capture or None,
+            errors="replace" if capture else None)
         w = WorkerProc(proc, b"")
         self._pending_registration[proc.pid] = w
         if capture:
@@ -896,6 +959,7 @@ class NodeAgent:
             "num_spilled": self.num_spilled,
             "bytes_spilled": self.bytes_spilled,
             "num_restored": self.num_restored,
+            "num_oom_kills": getattr(self, "num_oom_kills", 0),
             "spilled_objects": len(self._spilled),
             "event_stats": {m: tuple(v)
                             for m, v in self._server.event_stats.items()},

@@ -51,6 +51,8 @@ class SerializedValue:
         return msgpack.packb(
             [len(self.pickle_bytes), [len(b.raw()) for b in self.buffers]])
 
+    _COPY_CHUNK = 32 * 1024 * 1024
+
     def write_into(self, mem: memoryview) -> None:
         off = 0
         pb = self.pickle_bytes
@@ -58,8 +60,16 @@ class SerializedValue:
         off = _align(len(pb))
         for b in self.buffers:
             raw = b.raw()
-            mem[off:off + len(raw)] = raw
-            off = _align(off + len(raw))
+            # Chunked: one giant slice-assign is a single GIL-holding
+            # memcpy — a 1 GiB buffer would stall every other thread
+            # (including the RPC io loop) for its whole duration.
+            n = len(raw)
+            pos = 0
+            while pos < n:
+                end = min(n, pos + self._COPY_CHUNK)
+                mem[off + pos:off + end] = raw[pos:end]
+                pos = end
+            off = _align(off + n)
 
     def to_bytes(self) -> bytes:
         """Contiguous data section (for inline/RPC transport)."""

@@ -49,6 +49,31 @@ class InputNode(DAGNode):
         pass
 
 
+def _scan_nodes(value, out: List["DAGNode"]) -> None:
+    """Collect DAGNodes nested inside containers (one task arg may be a
+    list/tuple/dict holding node outputs)."""
+    if isinstance(value, DAGNode):
+        out.append(value)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            _scan_nodes(v, out)
+    elif isinstance(value, dict):
+        for v in value.values():
+            _scan_nodes(v, out)
+
+
+def _substitute(value, resolved: Dict[int, Any]):
+    if isinstance(value, DAGNode):
+        return resolved[id(value)]
+    if isinstance(value, list):
+        return [_substitute(v, resolved) for v in value]
+    if isinstance(value, tuple):
+        return tuple(_substitute(v, resolved) for v in value)
+    if isinstance(value, dict):
+        return {k: _substitute(v, resolved) for k, v in value.items()}
+    return value
+
+
 class ClassMethodNode(DAGNode):
     """One actor-method invocation in the graph (reference:
     dag/class_node.py ClassMethodNode)."""
@@ -59,9 +84,9 @@ class ClassMethodNode(DAGNode):
         self.method_name = method_name
         self.args = args
         self.kwargs = kwargs
-        for a in list(args) + list(kwargs.values()):
-            if isinstance(a, DAGNode):
-                self._upstream.append(a)
+        found: List[DAGNode] = []
+        _scan_nodes(list(args) + list(kwargs.values()), found)
+        self._upstream.extend(found)
 
 
 class MultiOutputNode(DAGNode):
@@ -69,21 +94,6 @@ class MultiOutputNode(DAGNode):
         super().__init__()
         self.outputs = list(outputs)
         self._upstream = list(outputs)
-
-
-class _BoundMethod:
-    def __init__(self, actor, name: str):
-        self._actor = actor
-        self._name = name
-
-    def bind(self, *args, **kwargs) -> ClassMethodNode:
-        return ClassMethodNode(self._actor, self._name, args, kwargs)
-
-
-def bind_method(actor, method_name: str) -> _BoundMethod:
-    """`actor.method.bind(...)` sugar lives on ActorMethod; this is the
-    functional spelling."""
-    return _BoundMethod(actor, method_name)
 
 
 class CompiledDAG:
@@ -123,10 +133,9 @@ class CompiledDAG:
             if isinstance(node, InputNode):
                 values[id(node)] = args[0]
             elif isinstance(node, ClassMethodNode):
-                call_args = [values[id(a)] if isinstance(a, DAGNode) else a
-                             for a in node.args]
-                call_kwargs = {k: values[id(v)] if isinstance(v, DAGNode)
-                               else v for k, v in node.kwargs.items()}
+                call_args = [_substitute(a, values) for a in node.args]
+                call_kwargs = {k: _substitute(v, values)
+                               for k, v in node.kwargs.items()}
                 method = getattr(node.actor, node.method_name)
                 values[id(node)] = method.remote(*call_args, **call_kwargs)
             elif isinstance(node, MultiOutputNode):

@@ -120,9 +120,12 @@ class LLMServer:
         pos = len(ids) - 1
         produced = 0
         first = True
-        while produced < max_new and pos + 1 < self.cfg.max_seq:
-            n = 1 if first else min(self.cfg.decode_chunk,
-                                    self.cfg.max_seq - 1 - pos)
+        # Stop when fewer than a full chunk of positions remain: only the
+        # 1-token and full-chunk shapes are ever compiled.
+        while produced < max_new and (
+                pos + 1 + (0 if first else self.cfg.decode_chunk)
+                <= self.cfg.max_seq):
+            n = 1 if first else self.cfg.decode_chunk
             first = False
             buf, pos2 = self._decode(self.params, buf, pos, n)
             new = [int(t) for t in np.asarray(

@@ -85,6 +85,11 @@ _flag("actor_max_restarts_default", int, 0, "Default actor restarts.")
 _flag("lineage_pinning_enabled", bool, True, "Pin lineage for object reconstruction.")
 _flag("gcs_storage_path", str, "", "Controller state snapshot file; empty = in-memory only (the reference's Redis-backed GCS fault tolerance analogue).")
 
+# --- memory monitor / OOM (reference: src/ray/common/memory_monitor.h + raylet/worker_killing_policy.cc) ---
+_flag("memory_monitor_refresh_ms", int, 500, "Node memory poll period; 0 disables OOM killing.")
+_flag("memory_usage_threshold", float, 0.95, "Kill a worker when node memory use exceeds this fraction.")
+_flag("memory_monitor_test_file", str, "", "Test seam: read memory usage fraction from this file instead of /proc/meminfo.")
+
 # --- chaos / testing (reference: src/ray/rpc/rpc_chaos.cc, RAY_testing_rpc_failure) ---
 _flag("testing_rpc_failure", str, "", "Comma list 'method=prob' to randomly fail RPCs.")
 _flag("testing_event_loop_delay_us", int, 0, "Inject delay into event-loop handlers (asio-delay analogue).")
@@ -95,7 +100,7 @@ _flag("tpu_visible_chips", str, "", "Analogue of TPU_VISIBLE_CHIPS pinning.")
 _flag("collective_cpu_fallback", bool, True, "Allow CPU fallback collectives when no TPU present.")
 
 # --- logging / observability ---
-_flag("log_to_driver", bool, True, "Stream worker stdout/stderr lines to the driver via the controller log_events channel.")
+_flag("log_to_driver", bool, True, "Stream worker stdout/stderr lines to the driver via the controller log_events channel. NOTE: the channel is cluster-global (no per-job scoping yet); multiple concurrent drivers see each other's worker output.")
 _flag("event_stats_enabled", bool, True, "Record per-handler event-loop stats.")
 _flag("task_events_batch_size", int, 1000, "Task events per batch sent to controller.")
 _flag("metrics_report_period_ms", int, 5000, "Metrics push period.")

@@ -1,0 +1,52 @@
+"""Memory monitor + OOM worker killing.
+
+Mirrors the reference's OOM design (reference: memory_monitor.h +
+worker_killing_policy_retriable_fifo.cc — under memory pressure the
+newest retriable task's worker is killed and the task retries).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+
+
+def test_oom_kills_and_task_retries(tmp_path):
+    from ray_tpu.utils.config import GlobalConfig
+    pressure = tmp_path / "pressure.txt"
+    pressure.write_text("0.0")
+    GlobalConfig.initialize({
+        "memory_monitor_test_file": str(pressure),
+        "memory_monitor_refresh_ms": 100,
+        "memory_usage_threshold": 0.9,
+    })
+    c = Cluster(num_nodes=1, resources={"CPU": 4})
+    c.connect()
+    try:
+        @ray_tpu.remote(max_retries=5)
+        def slow(x):
+            time.sleep(3.0)
+            return x * 2
+
+        @ray_tpu.remote
+        def warm():
+            return 1
+
+        ray_tpu.get(warm.remote())  # worker pool is warm: leases are fast
+        ref = slow.remote(21)
+        time.sleep(1.0)  # task is running on a leased worker
+        pressure.write_text("0.99")  # node goes into memory pressure
+        time.sleep(1.0)  # monitor kills the leased worker
+        pressure.write_text("0.0")  # pressure clears; retry succeeds
+        assert ray_tpu.get(ref, timeout=120) == 42
+
+        from ray_tpu import api
+        cw = api._cw()
+        stats = cw._run(cw.agent.call("agent_stats")).result(30)
+        assert stats.get("num_oom_kills", 0) >= 1, stats
+    finally:
+        c.shutdown()
+        GlobalConfig._overrides.clear()
+        GlobalConfig._cache.clear()
