@@ -4,8 +4,8 @@ from ray_tpu.train.checkpointing import (Checkpoint, CheckpointManager,
                                          load_checkpoint_host,
                                          restore_checkpoint)
 from ray_tpu.train.jax_trainer import JaxTrainer
-from ray_tpu.train.session import (get_context, get_dataset_shard, report,
-                                   save_checkpoint)
+from ray_tpu.train.session import (get_context, get_dataset_shard, profile,
+                                   report, save_checkpoint)
 from ray_tpu.train.spmd import (default_optimizer, make_train_fns,
                                 state_shardings)
 
@@ -13,6 +13,6 @@ __all__ = [
     "Checkpoint", "CheckpointConfig", "CheckpointManager", "FailureConfig",
     "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
     "default_optimizer", "get_context", "get_dataset_shard",
-    "load_checkpoint_host", "make_train_fns", "report",
+    "load_checkpoint_host", "make_train_fns", "profile", "report",
     "restore_checkpoint", "save_checkpoint", "state_shardings",
 ]

@@ -91,6 +91,34 @@ def get_dataset_shard(name: str = "train"):
     return get_context().get_dataset_shard(name)
 
 
+def profile(steps: int = 0):
+    """Context manager: capture a JAX profiler trace (XPlane, viewable in
+    TensorBoard/XProf) into the run's storage path (reference analogue:
+    SURVEY §5.1 — task timeline + JAX profiler as the TPU tracing story).
+
+        with ray_tpu.train.profile():
+            state, m = step_fn(state, batch)
+    """
+    import contextlib
+    import os
+
+    @contextlib.contextmanager
+    def _ctx():
+        import jax
+        ctx = get_context()
+        base = ctx.storage_path or "/tmp/ray_tpu_profiles"
+        out = os.path.join(base, ctx.experiment_name or "train_run",
+                           f"profile-rank{ctx.rank}")
+        os.makedirs(out, exist_ok=True)
+        jax.profiler.start_trace(out)
+        try:
+            yield out
+        finally:
+            jax.profiler.stop_trace()
+
+    return _ctx()
+
+
 def save_checkpoint(state: Any, step: int,
                     metrics: Optional[Dict[str, Any]] = None):
     """Sharded save of a jax pytree into the run's storage path; call from

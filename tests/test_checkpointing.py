@@ -147,3 +147,25 @@ def test_trainer_crash_resume(cluster, tmp_path):
     assert hist[-1]["step"] == 5
     # w accumulated across the crash: step k ends with w0 == k+1.
     assert hist[-1]["w0"] == 6.0
+
+
+def test_profile_captures_trace(tmp_path):
+    """ray_tpu.train.profile() writes an XPlane trace dir (SURVEY §5.1)."""
+    import os
+
+    import jax.numpy as jnp
+
+    from ray_tpu.train import session as sess
+
+    ctx = sess.TrainContext(0, 1, "proftest", str(tmp_path))
+    sess._start_session(ctx)
+    try:
+        with sess.profile() as out:
+            x = jnp.ones((64, 64))
+            (x @ x).block_until_ready()
+        found = []
+        for root, _dirs, files in os.walk(out):
+            found.extend(files)
+        assert found, f"no trace files under {out}"
+    finally:
+        sess._end_session()
