@@ -42,23 +42,38 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy",
-                    fn_kwargs: Optional[dict] = None) -> "Dataset":
+                    fn_kwargs: Optional[dict] = None,
+                    concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = ()) -> "Dataset":
         """Apply fn to batches (reference: dataset.py:457). With
         batch_size=None each block is one batch; otherwise blocks are
         re-chunked to batch_size rows (within a block; a trailing short
         batch per block is possible, as with the reference's default
-        shuffle=False zero-copy path)."""
+        shuffle=False zero-copy path).
+
+        concurrency=N runs the transform on a pool of N ACTORS instead of
+        fusing it into the source tasks (reference:
+        ActorPoolMapOperator / map_batches(CallableClass, concurrency=N))
+        — pass a callable CLASS to construct once per actor (model
+        loading etc.) and call per batch."""
+        if concurrency is not None:
+            if concurrency < 1:
+                raise ValueError(f"concurrency must be >= 1, "
+                                 f"got {concurrency}")
+            return _ActorMapDataset(self, fn, batch_size, batch_format,
+                                    concurrency, fn_constructor_args,
+                                    fn_kwargs or {})
+        if isinstance(fn, type) or fn_constructor_args:
+            # Fused stages call fn(batch); a callable CLASS would be
+            # constructed per batch WITH the batch as its ctor arg.
+            raise ValueError(
+                "callable classes / fn_constructor_args require "
+                "concurrency=N (the actor-compute strategy)")
         kwargs = fn_kwargs or {}
 
         def stage(block):
-            from ray_tpu.data.iterator import _format_batch
-            acc = BlockAccessor(block)
-            n = acc.num_rows()
-            step = batch_size or n or 1
-            for lo in _py_range(0, n, step):
-                batch = acc.slice(lo, min(n, lo + step))
-                out = fn(_format_batch(batch, batch_format), **kwargs)
-                yield out
+            yield from _map_block_batches(block, fn, batch_size,
+                                          batch_format, kwargs)
 
         return self._with_stage(stage, "map_batches")
 
@@ -182,7 +197,7 @@ class Dataset:
         """Global shuffle: materialize + permute (single-task; fine at the
         block counts this framework targets per host — the reference's
         distributed shuffle service is multi-TB scale)."""
-        n_blocks = max(1, len(self._sources))
+        n_blocks = max(1, self.num_blocks())
         mat = self.materialize()
 
         @ray_tpu.remote(num_returns="streaming")
@@ -227,6 +242,139 @@ class Dataset:
     def __repr__(self):
         return (f"Dataset(name={self._name!r}, "
                 f"blocks={len(self._sources)}, stages={len(self._stages)})")
+
+
+def _map_block_batches(block, call, batch_size, batch_format, kwargs):
+    """One block -> transformed output batches (shared by the fused
+    stage and the actor-compute worker so batching semantics can't
+    diverge)."""
+    from ray_tpu.data.iterator import _format_batch
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    step = batch_size or n or 1
+    for lo in _py_range(0, n, step):
+        batch = acc.slice(lo, min(n, lo + step))
+        yield call(_format_batch(batch, batch_format), **kwargs)
+
+
+class _MapActor:
+    """Pool worker for actor-compute map_batches (reference:
+    _map_actor_context in map_operator actors)."""
+
+    def __init__(self, fn_blob: bytes, ctor_args_blob: bytes,
+                 batch_size: Optional[int], batch_format: str,
+                 kwargs_blob: bytes):
+        import cloudpickle
+        fn = cloudpickle.loads(fn_blob)
+        ctor_args = cloudpickle.loads(ctor_args_blob)
+        self._kwargs = cloudpickle.loads(kwargs_blob)
+        # A callable CLASS is constructed once per actor.
+        self._callable = fn(*ctor_args) if isinstance(fn, type) else fn
+        self._batch_size = batch_size
+        self._batch_format = batch_format
+
+    def apply(self, block):
+        outs = list(_map_block_batches(block, self._callable,
+                                       self._batch_size,
+                                       self._batch_format, self._kwargs))
+        return concat_blocks(outs) if len(outs) != 1 else outs[0]
+
+
+class _ActorMapDataset(Dataset):
+    """A Dataset whose next stage runs on an actor pool; further
+    transforms chain as fused per-block streaming tasks downstream."""
+
+    def __init__(self, upstream: Dataset, fn, batch_size, batch_format,
+                 concurrency: int, ctor_args: tuple, fn_kwargs: dict,
+                 stages: Optional[List] = None):
+        super().__init__([], stages,
+                         name=f"{upstream._name}->map_batches(actors)")
+        self._upstream = upstream
+        self._fn = fn
+        self._batch_size = batch_size
+        self._batch_format = batch_format
+        self._concurrency = concurrency
+        self._ctor_args = ctor_args
+        self._fn_kwargs = fn_kwargs
+
+    def _with_stage(self, stage, name: str) -> "Dataset":
+        return _ActorMapDataset(self._upstream, self._fn,
+                                self._batch_size, self._batch_format,
+                                self._concurrency, self._ctor_args,
+                                self._fn_kwargs,
+                                self._stages + [stage])
+
+    def num_blocks(self) -> int:
+        return self._upstream.num_blocks()
+
+    def iter_block_refs(self, window: int = 2) -> Iterator[Any]:
+        from collections import deque
+
+        import cloudpickle
+
+        import ray_tpu
+
+        actor_cls = ray_tpu.remote(_MapActor)
+        actors = [actor_cls.remote(
+            cloudpickle.dumps(self._fn), cloudpickle.dumps(self._ctor_args),
+            self._batch_size, self._batch_format,
+            cloudpickle.dumps(self._fn_kwargs))
+            for _ in _py_range(self._concurrency)]
+        cap = 2 * self._concurrency
+
+        def actor_refs():
+            recent: deque = deque(maxlen=cap)
+            exhausted = False
+            try:
+                inflight: deque = deque()
+                rr = 0
+                for ref in self._upstream.iter_block_refs(window=window):
+                    if len(inflight) >= cap:  # upstream backpressure
+                        head = inflight.popleft()
+                        ray_tpu.wait([head], num_returns=1)
+                        yield head
+                    out = actors[rr % len(actors)].apply.remote(ref)
+                    rr += 1
+                    inflight.append(out)
+                    recent.append(out)
+                while inflight:
+                    yield inflight.popleft()
+                exhausted = True
+            finally:
+                # Normal exhaustion: wait for yielded-but-unfetched
+                # results to finish materializing (consumers prefetch
+                # refs) — no arbitrary cutoff killing slow transforms.
+                # Early abandonment (take(k), closed generator): the
+                # consumer won't fetch anything more; kill immediately.
+                if exhausted and recent:
+                    try:
+                        ray_tpu.wait(list(recent),
+                                     num_returns=len(recent))
+                    except Exception:
+                        pass
+                for a in actors:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:
+                        pass
+
+        refs = actor_refs()
+        if not self._stages:
+            yield from refs
+            return
+        # Chained transforms run as fused per-block streaming tasks.
+        from collections import deque
+
+        from ray_tpu.data.executor import _source_task_fn
+        stages_blob = cloudpickle.dumps(self._stages)
+        remote_fn = ray_tpu.remote(num_returns="streaming")(_source_task_fn)
+        pending: deque = deque()
+        for ref in refs:
+            pending.append(remote_fn.remote(ref, stages_blob))
+            while len(pending) > window:
+                yield from pending.popleft()
+        while pending:
+            yield from pending.popleft()
 
 
 class DataIterator:

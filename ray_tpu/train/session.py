@@ -91,7 +91,7 @@ def get_dataset_shard(name: str = "train"):
     return get_context().get_dataset_shard(name)
 
 
-def profile(steps: int = 0):
+def profile():
     """Context manager: capture a JAX profiler trace (XPlane, viewable in
     TensorBoard/XProf) into the run's storage path (reference analogue:
     SURVEY §5.1 — task timeline + JAX profiler as the TPU tracing story).
