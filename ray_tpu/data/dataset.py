@@ -182,13 +182,8 @@ class Dataset:
 
         @ray_tpu.remote(num_returns="streaming")
         def _rechunk(refs, n):
-            blocks = [ray_tpu.get(r) for r in refs]
-            whole = concat_blocks(blocks)
-            acc = BlockAccessor(whole)
-            total = acc.num_rows()
-            per = (total + n - 1) // n
-            for lo in _py_range(0, total, per):
-                yield acc.slice(lo, min(total, lo + per))
+            whole = concat_blocks([ray_tpu.get(r) for r in refs])
+            yield from _emit_chunks(BlockAccessor(whole), n)
 
         refs = [r for r in _rechunk.remote(mat._sources, num_blocks)]
         return Dataset(refs, [], name=f"{self._name}(repartition)")
@@ -212,13 +207,47 @@ class Dataset:
             else:
                 rows = acc.to_rows()
                 shuffled = [rows[i] for i in perm]
-            sacc = BlockAccessor(shuffled)
-            per = (total + n - 1) // n
-            for lo in _py_range(0, total, per):
-                yield sacc.slice(lo, min(total, lo + per))
+            yield from _emit_chunks(BlockAccessor(shuffled), n)
 
         refs = [r for r in _shuffle.remote(mat._sources, n_blocks, seed)]
         return Dataset(refs, [], name=f"{self._name}(shuffled)")
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (reference: dataset.py union). Blocks of
+        each input stream in order (materialization-free); transforms
+        chained after the union apply to every part."""
+        return _UnionDataset([self, *others])
+
+    def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        """Global sort by a column (reference: dataset.py sort), STABLE
+        in both directions. Materialize + single-task sort + re-chunk —
+        fine at per-host block counts (the reference's distributed
+        range-partition sort is multi-TB scale)."""
+        n_blocks = max(1, self.num_blocks())
+        mat = self.materialize()
+
+        @ray_tpu.remote(num_returns="streaming")
+        def _sorted(refs, n, key, descending):
+            whole = concat_blocks([ray_tpu.get(r) for r in refs])
+            acc = BlockAccessor(whole)
+            if isinstance(whole, dict):
+                v = whole[key]
+                if descending:
+                    # Stable descending: argsort the negated RANK codes
+                    # (reversing an ascending argsort would reverse ties).
+                    _, inv = np.unique(v, return_inverse=True)
+                    order = np.argsort(-inv, kind="stable")
+                else:
+                    order = np.argsort(v, kind="stable")
+                out: Block = {k: col[order] for k, col in whole.items()}
+            else:
+                out = sorted(acc.to_rows(),
+                             key=lambda r: r[key], reverse=descending)
+            yield from _emit_chunks(BlockAccessor(out), n)
+
+        refs = [r for r in _sorted.remote(mat._sources, n_blocks, key,
+                                          descending)]
+        return Dataset(refs, [], name=f"{self._name}(sorted)")
 
     def split(self, n: int) -> List["Dataset"]:
         """Materialize and split into n datasets by whole blocks
@@ -242,6 +271,38 @@ class Dataset:
     def __repr__(self):
         return (f"Dataset(name={self._name!r}, "
                 f"blocks={len(self._sources)}, stages={len(self._stages)})")
+
+
+def _emit_chunks(acc: "BlockAccessor", n: int):
+    """Slice a block into ~n chunks (shared by repartition / shuffle /
+    sort; handles the empty-block case)."""
+    total = acc.num_rows()
+    if total == 0:
+        return
+    per = max(1, (total + n - 1) // n)
+    for lo in _py_range(0, total, per):
+        yield acc.slice(lo, min(total, lo + per))
+
+
+class _UnionDataset(Dataset):
+    """Concatenation of several datasets; chained transforms push down
+    into every part (Dataset._with_stage would rebuild from the empty
+    source list and silently drop everything)."""
+
+    def __init__(self, parts: List["Dataset"]):
+        super().__init__([], [], name="union")
+        self._parts = parts
+
+    def _with_stage(self, stage, name: str) -> "Dataset":
+        return _UnionDataset([p._with_stage(stage, name)
+                              for p in self._parts])
+
+    def num_blocks(self) -> int:
+        return sum(p.num_blocks() for p in self._parts)
+
+    def iter_block_refs(self, window: int = 2):
+        for p in self._parts:
+            yield from p.iter_block_refs(window=window)
 
 
 def _map_block_batches(block, call, batch_size, batch_format, kwargs):

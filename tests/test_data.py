@@ -221,3 +221,27 @@ def test_map_batches_actor_compute(cluster):
            .filter(lambda r: r["id"] % 2 == 0))
     got = sorted(r["id"] for r in ds2.take_all())
     assert got == [i for i in range(40) if i % 2 == 0]
+
+
+def test_union_and_sort(cluster):
+    a = rdata.range(10, num_blocks=2)
+    b = rdata.range(10, num_blocks=2).map_batches(
+        lambda x: {"id": x["id"] + 100})
+    u = a.union(b)
+    assert u.num_blocks() == 4
+    ids = sorted(r["id"] for r in u.take_all())
+    assert ids == list(range(10)) + [100 + i for i in range(10)]
+
+    sh = rdata.range(30, num_blocks=3).random_shuffle(seed=1)
+    asc = [r["id"] for r in sh.sort("id").take_all()]
+    assert asc == list(range(30))
+    desc = [r["id"] for r in sh.sort("id", descending=True).take_all()]
+    assert desc == list(range(29, -1, -1))
+
+
+def test_union_with_downstream_transform_and_empty_sort(cluster):
+    u = rdata.range(6, num_blocks=2).union(rdata.range(6, num_blocks=2))
+    doubled = sorted(r["id"] for r in u.map_batches(
+        lambda b: {"id": b["id"] * 2}).take_all())
+    assert doubled == sorted([2 * i for i in range(6)] * 2)
+    assert rdata.from_items([]).sort("id").take_all() == []
