@@ -488,7 +488,12 @@ class Controller:
                         entry.resources,
                         entry.placement[0] if entry.placement else None,
                         entry.placement[1] if entry.placement else -1,
-                        env_vars=entry.runtime_env.get("env_vars"))
+                        env_vars=entry.runtime_env.get("env_vars"),
+                        # REMAINING restarts: the agent's OOM picker must
+                        # not kill an actor whose restart budget is spent.
+                        max_restarts=(-1 if entry.max_restarts == -1 else
+                                      max(0, entry.max_restarts
+                                          - entry.restarts_used)))
                     entry.addr = tuple(reply["addr"])
                     entry.node_id = node.node_id
                     entry.state = ActorState.ALIVE

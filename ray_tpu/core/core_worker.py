@@ -143,6 +143,10 @@ class CoreWorker:
         self._actor_arg_refs: Dict[bytes, List[ObjectRef]] = {}
         # Streaming-generator task state (owner side), keyed by task_id.
         self._streams: Dict[bytes, _StreamState] = {}
+        # Proxy borrows on refs forwarded inside replies, held until the
+        # receiver acks (ack_reply_refs) or the grace fallback fires.
+        self._reply_holds: Dict[Any, list] = {}
+        self._reply_hold_timers: Dict[Any, Any] = {}
         # Cancellation: task_ids cancelled by the user; where tasks execute.
         self._cancelled: set = set()
         self._task_exec_addr: Dict[bytes, Address] = {}
@@ -392,11 +396,7 @@ class CoreWorker:
         # Drop the borrows this object held on its contained refs.
         for r in e.contained:
             try:
-                if self._is_self_owned(r):
-                    await self.remove_borrow(r.binary())
-                else:
-                    await self._notify_remove_borrow(tuple(r.owner_addr),
-                                                     r.binary())
+                await self._release_borrow(r)
             except Exception:
                 pass
 
@@ -466,7 +466,8 @@ class CoreWorker:
     @long_poll
     async def report_streamed_return(self, task_id: bytes, index: int,
                                      kind: str, data, meta, node_id,
-                                     addr, size: int) -> dict:
+                                     addr, size: int,
+                                     ref_descs=()) -> dict:
         st = self._streams.get(task_id)
         if st is None or st.released:
             # Consumer gone: tell the producer to stop.
@@ -484,6 +485,11 @@ class CoreWorker:
                 self._mark_ready_inline(oid, data, meta)
             else:
                 self._mark_ready_stored(oid, node_id, tuple(addr), size)
+            if ref_descs:
+                # Adopt forwarded refs BEFORE replying: the producer drops
+                # its proxy borrow as soon as this RPC returns.
+                await self._adopt_reply_refs(task_id,
+                                             [(oid, ref_descs)], None)
             st.produced = max(st.produced, index + 1)
             if st.event is not None:
                 st.event.set()
@@ -1042,7 +1048,7 @@ class CoreWorker:
         self._task_exec_addr[spec.task_id] = tuple(client._address)
         try:
             reply = await client.call("push_task", cloudpickle.dumps(spec))
-            self._process_task_reply(spec, reply)
+            self._process_task_reply(spec, reply, client)
             self._release_arg_refs(spec)
             if not fut.done():
                 fut.set_result(None)
@@ -1071,7 +1077,8 @@ class CoreWorker:
         for ref in self._actor_arg_refs.pop(actor_id, ()):
             self.remove_local_ref(ref)
 
-    def _process_task_reply(self, spec: TaskSpec, reply: dict) -> None:
+    def _process_task_reply(self, spec: TaskSpec, reply: dict,
+                            client: Optional[RpcClient] = None) -> None:
         self._record_task_event(
             spec.task_id, spec.name,
             "failed" if reply.get("error") is not None else "finished")
@@ -1092,13 +1099,52 @@ class CoreWorker:
                 if st.event is not None:
                     st.event.set()
             return
+        adopt: list = []  # (oid, ref_descs) for refs forwarded in results
         for i, ret in enumerate(reply["returns"]):
             oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
             if ret[0] == "inline":
                 self._mark_ready_inline(oid.binary(), ret[1], ret[2])
-            else:  # ("stored", node_id, agent_addr, size)
+                descs = ret[3] if len(ret) > 3 else ()
+            else:  # ("stored", node_id, agent_addr, size, ref_descs)
                 self._mark_ready_stored(oid.binary(), ret[1], tuple(ret[2]),
                                         ret[3])
+                descs = ret[4] if len(ret) > 4 else ()
+            if descs:
+                adopt.append((oid.binary(), descs))
+        if adopt:
+            self._spawn(self._adopt_reply_refs(spec.task_id, adopt, client))
+
+    async def _adopt_reply_refs(self, task_id: bytes, adopt: list,
+                                client: Optional[RpcClient]) -> None:
+        """Register this owner's borrows on ObjectRefs forwarded inside a
+        task's results, attach them to the result entries (released when
+        the result is freed), then ack the executing worker so it drops
+        its proxy borrow — the handoff is confirmed, not timer-based."""
+        for oid, descs in adopt:
+            refs = []
+            for b, owner in descs:
+                r = ObjectRef(ObjectID(bytes(b)),
+                              tuple(owner) if owner else None)
+                # Lifetime is managed via the entry's contained-borrow
+                # protocol (like put), not Python GC of this proxy object.
+                r._weakref_released = True
+                if self._is_self_owned(r):
+                    await self.add_borrow(r.binary())
+                else:
+                    await self._notify_add_borrow(tuple(r.owner_addr),
+                                                  r.binary())
+                refs.append(r)
+            e = self.objects.get(oid)
+            if e is not None:
+                e.contained.extend(refs)
+            else:  # result already freed: release the borrows right away
+                for r in refs:
+                    await self._release_borrow(r)
+        if client is not None:
+            try:
+                await client.call("ack_reply_refs", task_id)
+            except Exception:
+                pass  # worker gone: its grace fallback cleans up
 
     # ------------------------------------------------------------------
     # cancellation (owner side; reference: core_worker.cc CancelTask)
@@ -1326,7 +1372,7 @@ class CoreWorker:
                                               cloudpickle.dumps(spec))
                 finally:
                     self._task_exec_addr.pop(spec.task_id, None)
-                self._process_task_reply(spec, reply)
+                self._process_task_reply(spec, reply, client)
                 self._release_arg_refs(spec)
                 return
             except (RpcConnectionLost, ConnectionError, OSError) as e:
@@ -1515,23 +1561,29 @@ class CoreWorker:
         returns = []
         for i, value in enumerate(results):
             sv = serialization.serialize(value)
-            await self._hold_reply_refs(sv.contained_refs)
+            ref_descs = [(r.binary(),
+                          tuple(r.owner_addr) if r.owner_addr else None)
+                         for r in sv.contained_refs]
+            await self._hold_reply_refs(spec.task_id, sv.contained_refs)
             oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
             if sv.total_size <= GlobalConfig.max_direct_call_object_size:
-                returns.append(("inline", sv.to_bytes(), sv.meta()))
+                returns.append(("inline", sv.to_bytes(), sv.meta(),
+                                ref_descs))
             else:
                 await self._store_put(oid.binary(), sv)
                 returns.append(("stored", self.node_id, self.agent_addr,
-                                sv.total_size))
+                                sv.total_size, ref_descs))
         return {"error": None, "returns": returns}
 
-    async def _hold_reply_refs(self, contained_refs) -> None:
+    async def _hold_reply_refs(self, key, contained_refs) -> None:
         """ObjectRefs FORWARDED inside a task result race their own
         lifetime: once serialized, the worker's last Python reference can
         die (freeing a self-owned object) before the receiver's borrow
-        registration lands. Take a proxy borrow for a grace window so the
-        handoff always survives (reference: reference_count.cc tracks
-        borrowers through nested task returns explicitly)."""
+        registration lands. Take a proxy borrow held until the receiver
+        ACKNOWLEDGES that its own borrow landed (ack_reply_refs), with a
+        long fallback timer only for receiver death (reference:
+        reference_count.cc tracks borrowers through nested task returns
+        explicitly)."""
         refs = list(contained_refs)
         if not refs:
             return
@@ -1541,20 +1593,40 @@ class CoreWorker:
             else:
                 await self._notify_add_borrow(tuple(r.owner_addr),
                                               r.binary())
+        fresh = key not in self._reply_holds
+        self._reply_holds.setdefault(key, []).extend(refs)
+        if fresh:
+            # Fallback only: a live receiver acks well before this (which
+            # cancels the timer); a dead receiver's borrows are moot, so
+            # release ours eventually.
+            async def _drop_after_grace():
+                await asyncio.sleep(GlobalConfig.reply_ref_grace_s)
+                self._reply_hold_timers.pop(key, None)
+                await self.ack_reply_refs(key)
 
-        async def _drop_after_grace():
-            await asyncio.sleep(120)
-            for r in refs:
-                try:
-                    if self._is_self_owned(r):
-                        await self.remove_borrow(r.binary())
-                    else:
-                        await self._notify_remove_borrow(
-                            tuple(r.owner_addr), r.binary())
-                except Exception:
-                    pass
+            self._reply_hold_timers[key] = spawn(_drop_after_grace())
 
-        spawn(_drop_after_grace())
+    async def ack_reply_refs(self, key) -> None:
+        """Receiver confirms its borrow on forwarded reply refs landed:
+        drop the proxy borrows taken in _hold_reply_refs. Idempotent."""
+        if isinstance(key, list):  # over-the-wire tuples arrive as lists
+            key = tuple(key)
+        timer = self._reply_hold_timers.pop(key, None)
+        if timer is not None:
+            timer.cancel()
+        for r in self._reply_holds.pop(key, ()):
+            await self._release_borrow(r)
+
+    async def _release_borrow(self, r: ObjectRef) -> None:
+        """Drop one borrow on a ref, local or via its remote owner."""
+        try:
+            if self._is_self_owned(r):
+                await self.remove_borrow(r.binary())
+            else:
+                await self._notify_remove_borrow(tuple(r.owner_addr),
+                                                 r.binary())
+        except Exception:
+            pass
 
     async def _execute_streaming(self, spec: TaskSpec, fn) -> dict:
         """Run a generator task: the exec thread pulls items from the user
@@ -1626,17 +1698,28 @@ class CoreWorker:
     async def _emit_stream_item(self, owner: RpcClient, spec: TaskSpec,
                                 index: int, sv) -> bool:
         """Report one yielded item to the owner; False = consumer gone."""
-        await self._hold_reply_refs(sv.contained_refs)
-        if sv.total_size <= GlobalConfig.max_direct_call_object_size:
-            reply = await owner.call(
-                "report_streamed_return", spec.task_id, index, "inline",
-                sv.to_bytes(), sv.meta(), None, None, 0)
-        else:
-            oid = ObjectID.for_task_return(TaskID(spec.task_id), index)
-            await self._store_put(oid.binary(), sv)
-            reply = await owner.call(
-                "report_streamed_return", spec.task_id, index, "stored",
-                None, None, self.node_id, self.agent_addr, sv.total_size)
+        hold_key = (spec.task_id, index)
+        ref_descs = [(r.binary(),
+                      tuple(r.owner_addr) if r.owner_addr else None)
+                     for r in sv.contained_refs]
+        await self._hold_reply_refs(hold_key, sv.contained_refs)
+        try:
+            if sv.total_size <= GlobalConfig.max_direct_call_object_size:
+                reply = await owner.call(
+                    "report_streamed_return", spec.task_id, index, "inline",
+                    sv.to_bytes(), sv.meta(), None, None, 0, ref_descs)
+            else:
+                oid = ObjectID.for_task_return(TaskID(spec.task_id), index)
+                await self._store_put(oid.binary(), sv)
+                reply = await owner.call(
+                    "report_streamed_return", spec.task_id, index, "stored",
+                    None, None, self.node_id, self.agent_addr,
+                    sv.total_size, ref_descs)
+        finally:
+            # The owner registers its borrows inside the report handler,
+            # before replying — so the RPC returning (or failing: a dead
+            # owner's borrows are moot) confirms the handoff.
+            await self.ack_reply_refs(hold_key)
         return bool(reply.get("accepted"))
 
     # ------------------------------------------------------------------

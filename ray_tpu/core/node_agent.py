@@ -71,6 +71,8 @@ class WorkerProc:
         self.dedicated_actor: Optional[bytes] = None
         self.current_lease: Optional[bytes] = None
         self.idle_since: float = 0.0
+        self.spawned_at: float = time.monotonic()
+        self.max_restarts: int = 0  # for dedicated actor workers
 
 
 class NodeAgent:
@@ -255,32 +257,42 @@ class NodeAgent:
             usage = self._memory_usage_fraction()
             if usage <= threshold:
                 continue
-            victim = self._pick_oom_victim()
+            victim, retriable = self._pick_oom_victim()
             if victim is None:
                 continue
             logger.warning(
-                "node memory %.0f%% > %.0f%%: killing worker pid=%s "
-                "(its tasks are retriable)", usage * 100, threshold * 100,
-                getattr(victim.proc, "pid", "?"))
+                "node memory %.0f%% > %.0f%%: killing worker pid=%s (%s)",
+                usage * 100, threshold * 100,
+                getattr(victim.proc, "pid", "?"),
+                "its tasks are retriable" if retriable
+                else "restartable actor")
             self.num_oom_kills = getattr(self, "num_oom_kills", 0) + 1
             try:
                 victim.proc.terminate()
             except Exception:
                 pass
+            # Cooldown: let the kill land and memory readings catch up
+            # before selecting another victim, else sustained pressure
+            # kills one worker per tick faster than /proc/meminfo moves.
+            await asyncio.sleep(max(period, 1.0))
 
-    def _pick_oom_victim(self) -> Optional[WorkerProc]:
-        """Newest LEASED task worker first (retriable-FIFO): its task
-        retries; dedicated actor workers only as a last resort (actor
-        restarts are scarcer), external procs never."""
+    def _pick_oom_victim(self) -> Tuple[Optional[WorkerProc], bool]:
+        """Newest LEASED task worker first (retriable-FIFO, by spawn time
+        — PIDs wrap and get reused); dedicated actor workers only as a
+        last resort and only if their actor can restart (killing a
+        max_restarts=0 actor permanently fails it); external procs never.
+        Returns (victim, tasks_are_retriable)."""
         leased = [w for w in self.workers.values()
                   if w.current_lease is not None
                   and isinstance(w.proc, subprocess.Popen)]
         if leased:
-            return max(leased, key=lambda w: w.proc.pid)
+            return max(leased, key=lambda w: w.spawned_at), True
         actors = [w for w in self.workers.values()
-                  if w.dedicated_actor is not None
+                  if w.dedicated_actor is not None and w.max_restarts != 0
                   and isinstance(w.proc, subprocess.Popen)]
-        return max(actors, key=lambda w: w.proc.pid) if actors else None
+        if actors:
+            return max(actors, key=lambda w: w.spawned_at), False
+        return None, False
 
     async def _reap_loop(self) -> None:
         """Monitor child worker processes; clean up on death; retire idle
@@ -585,7 +597,8 @@ class NodeAgent:
     async def start_actor(self, actor_id: bytes, spec_blob: bytes,
                           resources: dict, pg: Optional[bytes],
                           bundle_index: int,
-                          env_vars: Optional[Dict[str, str]] = None) -> dict:
+                          env_vars: Optional[Dict[str, str]] = None,
+                          max_restarts: int = 0) -> dict:
         tpu_req = float(resources.get("TPU", 0))
         if tpu_req != int(tpu_req):
             # Chips are whole devices: fractional TPU would desynchronize
@@ -617,6 +630,7 @@ class NodeAgent:
             await asyncio.wait_for(w.ready.wait(),
                                    GlobalConfig.worker_register_timeout_s)
             w.dedicated_actor = actor_id
+            w.max_restarts = max_restarts
             if chips:
                 self.tpu_assigned[actor_id] = chips
             self.actor_allocations[actor_id] = (dict(resources), pg,

@@ -215,22 +215,41 @@ class RpcServer:
                 status, body = await self._execute(method, payload)
                 if not fut.done():
                     fut.set_result((status, body))
-                self._dedup_bytes += len(body)
-                while (len(self._dedup) > self._DEDUP_CAP
-                       or self._dedup_bytes > self._DEDUP_MAX_BYTES):
-                    old_rid, old_fut = self._dedup.popitem(last=False)
-                    if old_fut.done():
-                        try:
-                            self._dedup_bytes -= len(old_fut.result()[1])
-                        except Exception:
-                            pass
-                    if not self._dedup:
-                        break
+                # In-flight entries are never evicted (below), so the entry
+                # is still present here; bytes are only ever accounted for
+                # entries in the map and subtracted symmetrically on evict.
+                if rid in self._dedup:
+                    self._dedup_bytes += len(body)
+                self._evict_dedup()
         try:
             _write_msg(writer, [seqno, status, body])
             await writer.drain()
         except (ConnectionResetError, BrokenPipeError):
             pass
+
+    def _evict_dedup(self) -> None:
+        """Evict completed entries oldest-first until within budget.
+
+        In-flight entries (long-poll handlers hold them open for minutes)
+        are rotated to the tail, never dropped: evicting one would lose the
+        exactly-once guard, letting a transport retry of a mutating call
+        (e.g. an actor push_task carrying a seqno) re-execute. Their bytes
+        were never accounted, so the byte counter stays consistent.
+        """
+        scanned = 0
+        while ((len(self._dedup) > self._DEDUP_CAP
+                or self._dedup_bytes > self._DEDUP_MAX_BYTES)
+               and scanned < len(self._dedup)):
+            old_rid, old_fut = next(iter(self._dedup.items()))
+            if not old_fut.done():
+                self._dedup.move_to_end(old_rid)
+                scanned += 1
+                continue
+            del self._dedup[old_rid]
+            try:
+                self._dedup_bytes -= len(old_fut.result()[1])
+            except Exception:
+                pass
 
 
 class RpcClient:

@@ -33,6 +33,10 @@ class _DeploymentState:
         self.born: Dict[bytes, float] = {}     # actor_id -> creation time
         self.healthy: Dict[bytes, bool] = {}   # ever passed a health check
         self.last_scale = 0.0
+        # Autoscaler's replica target, kept OUT of the user-supplied
+        # config (reference keeps the autoscaled target in deployment
+        # state, never mutating the submitted config).
+        self.autoscale_target: Optional[int] = None
 
 
 class ServeController:
@@ -145,7 +149,8 @@ class ServeController:
             cfg.get("max_ongoing_requests", 100))
 
     def _reconcile_one(self, st: _DeploymentState) -> None:
-        target = int(st.config.get("num_replicas", 1))
+        target = (st.autoscale_target if st.autoscale_target is not None
+                  else int(st.config.get("num_replicas", 1)))
         changed = False
         while len(st.replicas) < target:
             r = self._make_replica(st)
@@ -255,8 +260,6 @@ class ServeController:
         auto = cfg.get("autoscaling_config")
         if not auto or not st.replicas:
             return
-        if time.time() - st.last_scale < auto.get("upscale_delay_s", 3.0):
-            return
         loads = [load_map.get(r.actor_id.binary()) for r in st.replicas]
         loads = [v for v in loads if v is not None]
         if not loads:
@@ -264,12 +267,20 @@ class ServeController:
         avg = sum(loads) / max(1, len(loads))
         target_ongoing = auto.get("target_ongoing_requests", 2.0)
         n = len(st.replicas)
+        since_scale = time.time() - st.last_scale
         want = n
+        # Upscale reacts fast; downscale waits much longer so a brief load
+        # dip doesn't drop replicas (reference: upscale_delay_s=30 vs
+        # downscale_delay_s=600 defaults, autoscaling_policy.py).
         if avg > target_ongoing:
+            if since_scale < auto.get("upscale_delay_s", 3.0):
+                return
             want = min(auto.get("max_replicas", 4), n + 1)
         elif avg < target_ongoing / 2:
+            if since_scale < auto.get("downscale_delay_s", 30.0):
+                return
             want = max(auto.get("min_replicas", 1), n - 1)
         if want != n:
-            st.config["num_replicas"] = want
+            st.autoscale_target = want
             st.last_scale = time.time()
             self._reconcile_one(st)

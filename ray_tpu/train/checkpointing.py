@@ -86,6 +86,17 @@ def save_checkpoint(directory: str, state: Any, step: int,
 
     proc = jax.process_index()
     ckpt_dir = os.path.join(directory, f"step-{step}")
+    # A prior partial/crashed save of the same step may have left shard
+    # files for a *different* topology behind; merging them with fresh
+    # shards would corrupt the checkpoint. Process 0 clears the dir, then
+    # everyone waits before writing (atomicity also backstopped by the
+    # exact shard manifest recorded in _METADATA.json below).
+    if proc == 0 and os.path.isdir(ckpt_dir):
+        import shutil
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt-begin-{step}")
     os.makedirs(ckpt_dir, exist_ok=True)
 
     flat = _leaf_paths(state)
@@ -100,15 +111,23 @@ def save_checkpoint(directory: str, state: Any, step: int,
                     key = _index_key(shard.index, shape)
                     np.save(os.path.join(ckpt_dir, f"leaf{li}.{key}.npy"),
                             np.asarray(shard.data), allow_pickle=False)
+            # Manifest: the exact global shard-key set (computable on any
+            # process from the global sharding) — readers trust only these
+            # files, so stale shards from a crashed save are never merged.
+            all_keys = sorted({_index_key(idx, shape) for idx in
+                               leaf.sharding.devices_indices_map(
+                                   shape).values()})
             meta["leaves"].append({"name": name, "kind": "array",
-                                   "shape": shape, "dtype": dtype})
+                                   "shape": shape, "dtype": dtype,
+                                   "files": all_keys})
         else:
             if proc == 0:
                 np.save(os.path.join(ckpt_dir, f"leaf{li}.host.npy"),
                         np.asarray(leaf), allow_pickle=False)
             meta["leaves"].append({"name": name, "kind": "host",
                                    "shape": tuple(np.shape(leaf)),
-                                   "dtype": str(np.asarray(leaf).dtype)})
+                                   "dtype": str(np.asarray(leaf).dtype),
+                                   "files": ["host"]})
 
     # Commit barrier: every process must have finished its writes before
     # the checkpoint becomes observable (reference: sync_actor.py barrier;
@@ -154,10 +173,16 @@ def restore_checkpoint(ckpt: "Checkpoint | str", target: Any) -> Any:
         dtype = np.dtype(lm["dtype"])
         sharding = leaf.sharding
         index_map = sharding.addressable_devices_indices_map(shape)
+        manifest = lm.get("files")
         cache: Dict[str, np.ndarray] = {}
         bufs = []
         for device, index in index_map.items():
             key = _index_key(index, shape)
+            if manifest is not None and key not in manifest:
+                raise FileNotFoundError(
+                    f"checkpoint {path} leaf{li} has no shard {key!r} "
+                    f"(saved under a different sharding — use "
+                    f"load_checkpoint_host for cross-topology restore)")
             if key not in cache:
                 cache[key] = np.load(
                     os.path.join(path, f"leaf{li}.{key}.npy")
@@ -187,9 +212,14 @@ def load_checkpoint_host(ckpt: "Checkpoint | str") -> Dict[str, np.ndarray]:
         shape = tuple(lm["shape"])
         full = np.empty(shape, dtype=np.dtype(lm["dtype"]))
         prefix = f"leaf{li}."
-        for fname in os.listdir(path):
-            if not (fname.startswith(prefix) and fname.endswith(".npy")):
-                continue
+        # Read only manifest-listed shards (never stray files from an
+        # earlier crashed save); fall back to listdir for old checkpoints.
+        if lm.get("files") is not None:
+            fnames = [f"{prefix}{key}.npy" for key in lm["files"]]
+        else:
+            fnames = [f for f in os.listdir(path)
+                      if f.startswith(prefix) and f.endswith(".npy")]
+        for fname in fnames:
             key = fname[len(prefix):-4]
             data = np.load(os.path.join(path, fname))
             if key == "scalar":

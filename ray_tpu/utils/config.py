@@ -80,6 +80,7 @@ _flag("worker_pool_idle_ttl_s", int, 300, "Kill idle workers after this long.")
 _flag("streaming_generator_backpressure_items", int, 16, "Yielded-but-unconsumed items before the producer stalls (reference: generator_waiter.cc backpressure).")
 
 # --- fault tolerance ---
+_flag("reply_ref_grace_s", int, 600, "Fallback window for proxy borrows on refs forwarded in task replies; a live receiver acks long before this, so it only bounds leaks when the receiver died.")
 _flag("max_task_retries_default", int, 3, "Default retries for retriable tasks.")
 _flag("actor_max_restarts_default", int, 0, "Default actor restarts.")
 _flag("lineage_pinning_enabled", bool, True, "Pin lineage for object reconstruction.")
