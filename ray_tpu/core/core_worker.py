@@ -88,6 +88,14 @@ class _StreamState:
         self.released = False
 
 
+def _ref_descs(sv) -> list:
+    """Wire descriptors for the ObjectRefs contained in a serialized
+    value: what the receiver needs to adopt borrows (adopt/ack
+    protocol)."""
+    return [(r.binary(), tuple(r.owner_addr) if r.owner_addr else None)
+            for r in sv.contained_refs]
+
+
 class CoreWorker:
     def __init__(self, mode: str, agent_addr: Address,
                  controller_addr: Address, session_dir: str = "/tmp"):
@@ -158,6 +166,12 @@ class CoreWorker:
         self._exec_threads: Dict[bytes, int] = {}
         # Device-resident objects (RDT): key -> jax array kept in HBM.
         self._device_objects: Dict[bytes, Any] = {}
+        self._device_consumers: Dict[bytes, int] = {}
+        self._device_tokens: Dict[bytes, Any] = {}  # re-registration guard
+        # Device channels: reader inboxes + writer-side release tracking.
+        self._channel_inbox: Dict[bytes, Any] = {}
+        self._channel_acks: Dict[bytes, Dict] = {}
+        self._channel_ack_events: Dict[bytes, Any] = {}
         # Task-event buffer, flushed to the controller in batches
         # (reference: task_event_buffer.cc -> gcs_task_manager.cc).
         # Guarded: submit runs on user threads, completion on the io loop.
@@ -385,8 +399,13 @@ class CoreWorker:
             return
         if e.borrow_refs > 0:
             return
-        # Free: drop store copies everywhere, forget the entry.
+        # Free: drop store copies everywhere, forget the entry. A
+        # device-resident twin (DeviceRef) shares the oid — its HBM
+        # array frees with the ledger entry (ownership integration;
+        # reference: gpu_object_manager.py hangs GPU objects off the
+        # ObjectRef protocol).
         self.objects.pop(oid, None)
+        self.free_device_object(oid)
         for node_id, addr in list(e.locations):
             try:
                 peer = self._client_for_worker(tuple(addr))
@@ -433,21 +452,88 @@ class CoreWorker:
     # plane while the tensor stays in device memory; transfer happens
     # out-of-band on fetch)
     # ------------------------------------------------------------------
-    def put_device_object(self, key: bytes, array: Any) -> None:
+    def put_device_object(self, key: bytes, array: Any,
+                          consumers: int = 0,
+                          ttl_s: float = 600.0) -> None:
+        """Hold an array in device memory under `key`. consumers>0 makes
+        the entry self-freeing after that many staged pulls (collective
+        rendezvous points), with a TTL backstop so a dead participant
+        cannot pin the array forever. Callable from any thread (dict ops
+        are GIL-atomic; waiters poll on the io loop)."""
+        token = object()
         self._device_objects[key] = array
+        self._device_tokens[key] = token
+        if consumers > 0:
+            self._device_consumers[key] = consumers
+
+            async def _ttl_free():
+                await asyncio.sleep(ttl_s)
+                if self._device_tokens.get(key) is token:
+                    self.free_device_object(key)
+
+            self._run(self._spawn_coro(_ttl_free()))
+
+    async def _spawn_coro(self, coro) -> None:
+        spawn(coro)
 
     def get_device_object_local(self, key: bytes) -> Any:
         return self._device_objects.get(key)
 
     def free_device_object(self, key: bytes) -> None:
         self._device_objects.pop(key, None)
+        self._device_consumers.pop(key, None)
+        self._device_tokens.pop(key, None)
+
+    @long_poll
+    async def device_pull_info(self, key: bytes,
+                               wait_s: float = 0.0) -> Optional[tuple]:
+        """Stage the device object for ONE pull by the calling peer and
+        return the tiny control tuple (transfer_addr, uuid, aval_descs).
+        The tensor itself never touches this RPC — the peer pulls it
+        device-to-device through the transfer plane. wait_s>0 parks until
+        the key is registered (collective rendezvous; a poll loop — the
+        producer may register from an exec thread, so no cross-thread
+        asyncio primitives)."""
+        arr = self._device_objects.get(key)
+        if arr is None and wait_s > 0:
+            deadline = asyncio.get_running_loop().time() + wait_s
+            while (arr is None
+                   and asyncio.get_running_loop().time() < deadline):
+                await asyncio.sleep(0.02)
+                arr = self._device_objects.get(key)
+        if arr is None:
+            return None
+        from ray_tpu.experimental.device_plane import DevicePlane
+        loop = asyncio.get_running_loop()
+        # Staging may reform a sharded array on-device; keep it off the
+        # io loop.
+        addr, uuid, descs = await loop.run_in_executor(
+            None, DevicePlane.get().stage, [arr])
+        left = self._device_consumers.get(key)
+        if left is not None:
+            if left <= 1:
+                # Last consumer staged. Defer the actual free briefly so
+                # a puller that hits a transfer failure can still reach
+                # the host-bytes fallback endpoint.
+                self._device_consumers.pop(key, None)
+                token = self._device_tokens.get(key)
+
+                async def _deferred_free():
+                    await asyncio.sleep(60.0)
+                    if self._device_tokens.get(key) is token:
+                        self.free_device_object(key)
+
+                spawn(_deferred_free())
+            else:
+                self._device_consumers[key] = left - 1
+        return (addr, uuid, descs)
 
     async def fetch_device_object(self, key: bytes) -> Optional[tuple]:
-        """Out-of-band transfer endpoint: device -> host array -> wire
+        """Host-bytes fallback endpoint (cross-backend transfers, or when
+        the transfer plane is unavailable): device -> host array -> wire
         (pickle-5 ships the buffer without an extra copy). The D2H copy
         runs OFF the io loop — a multi-GB transfer must not stall this
-        worker's RPC service. (Intra-slice ICI transfer without the host
-        hop is the planned fast path via the jax transfer server.)"""
+        worker's RPC service."""
         arr = self._device_objects.get(key)
         if arr is None:
             return None
@@ -458,6 +544,157 @@ class CoreWorker:
 
     async def free_device_object_remote(self, key: bytes) -> None:
         self.free_device_object(key)
+
+    # ------------------------------------------------------------------
+    # device channels (reference: experimental mutable-object channels,
+    # src/ray/core_worker/experimental_mutable_object_manager.h:44 —
+    # acquire/release slots; ours signals over RPC, moves data over the
+    # transfer plane)
+    # ------------------------------------------------------------------
+    async def channel_notify(self, channel_id: bytes, seq: int,
+                             writer_addr, addr: str, uuid: int,
+                             descs: list) -> None:
+        """A writer published item `seq`: enqueue the pull ticket for the
+        local reader."""
+        q = self._channel_inbox.get(channel_id)
+        if q is None:
+            q = self._channel_inbox[channel_id] = asyncio.Queue()
+        q.put_nowait((seq, tuple(writer_addr), addr, uuid, descs))
+
+    async def channel_release(self, channel_id: bytes, reader_addr,
+                              seq: int) -> None:
+        """A reader finished with item `seq` (writer-side handler)."""
+        st = self._channel_acks.get(channel_id)
+        if st is None:
+            st = self._channel_acks[channel_id] = {}
+        key = tuple(reader_addr)
+        st[key] = max(st.get(key, 0), seq)
+        ev = self._channel_ack_events.get(channel_id)
+        if ev is not None:
+            ev.set()
+
+    async def channel_next(self, channel_id: bytes,
+                           timeout: Optional[float]) -> tuple:
+        """Reader-side: wait for the next published item ticket."""
+        q = self._channel_inbox.get(channel_id)
+        if q is None:
+            q = self._channel_inbox[channel_id] = asyncio.Queue()
+        return await asyncio.wait_for(q.get(), timeout)
+
+    async def channel_wait_acks(self, channel_id: bytes, min_seq: int,
+                                n_readers: int,
+                                timeout: Optional[float]) -> None:
+        """Writer-side backpressure: park until every reader has released
+        item `min_seq` (or further)."""
+        deadline = (None if timeout is None
+                    else asyncio.get_running_loop().time() + timeout)
+        while True:
+            st = self._channel_acks.get(channel_id, {})
+            if (len(st) >= n_readers
+                    and all(v >= min_seq for v in st.values())):
+                return
+            ev = self._channel_ack_events.get(channel_id)
+            if ev is None or ev.is_set():
+                ev = self._channel_ack_events[channel_id] = asyncio.Event()
+            t = (None if deadline is None
+                 else deadline - asyncio.get_running_loop().time())
+            if t is not None and t <= 0:
+                raise asyncio.TimeoutError(
+                    f"channel {channel_id.hex()[:8]} backpressure: readers "
+                    f"did not release item {min_seq}")
+            await asyncio.wait_for(ev.wait(), t)
+
+    def drop_channel(self, channel_id: bytes) -> None:
+        self._channel_inbox.pop(channel_id, None)
+        self._channel_acks.pop(channel_id, None)
+        self._channel_ack_events.pop(channel_id, None)
+
+    # ------------------------------------------------------------------
+    # compiled-DAG builtins (executed like actor methods, provided by the
+    # worker; reference: python/ray/dag/compiled_dag_node.py actor loops
+    # + collective_node.py:252 CollectiveOutputNode)
+    # ------------------------------------------------------------------
+    def _builtin_dag_call(self, method_name: str, out_mode: str,
+                          *args, **kwargs):
+        """Run an actor method for a compiled DAG with device-plane IO:
+        DeviceRef args are materialized locally (device-to-device pull);
+        out_mode='device' keeps the result in HBM and ships only a
+        DeviceRef. Sync methods only (DAG nodes are compute steps)."""
+        from ray_tpu import device_objects
+
+        def _unwrap(v):
+            if isinstance(v, device_objects.DeviceRef):
+                return device_objects.device_get(v)
+            return v
+
+        args = [_unwrap(a) for a in args]
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        method = getattr(self._actor_instance, method_name)
+        import inspect as _inspect
+        if _inspect.iscoroutinefunction(method):
+            raise TypeError(
+                f"DAG device-transport edges require sync methods; "
+                f"{method_name!r} is async (its coroutine would be "
+                f"stored, not awaited)")
+        result = method(*args, **kwargs)
+        if out_mode == "device":
+            return device_objects.device_put_ref(result)
+        return result
+
+    def _builtin_dag_allreduce(self, op_key: bytes, rank: int, world: int,
+                               op: str, inputs: list,
+                               timeout: float = 120.0):
+        """In-DAG allreduce across the participating actors' device
+        arrays. Hub reduce: rank 0 pulls every peer's tensor over the
+        transfer plane, reduces on device, stages the result; other ranks
+        pull it (rendezvous by op_key with self-freeing consumer count).
+        All tensor movement is device-to-device; only control tuples ride
+        RPC."""
+        import jax.numpy as jnp
+
+        from ray_tpu import device_objects
+        from ray_tpu.core.ref import ObjectRef
+        from ray_tpu.experimental.device_plane import DevicePlane
+
+        # The group's inputs travel as a LIST of refs (nested refs are
+        # not auto-resolved by arg resolution): settle them to DeviceRefs.
+        inputs = [self.get([x], timeout)[0] if isinstance(x, ObjectRef)
+                  else x for x in inputs]
+        mine = device_objects.device_get(inputs[rank])
+        if world == 1:
+            return device_objects.device_put_ref(mine)
+        if rank == 0:
+            acc = mine
+            parts = [device_objects.device_get(inputs[j], timeout=timeout)
+                     for j in range(world) if j != 0]
+            if op in ("sum", "mean"):
+                for p in parts:
+                    acc = acc + p
+                if op == "mean":
+                    acc = acc / world
+            elif op == "max":
+                for p in parts:
+                    acc = jnp.maximum(acc, p)
+            elif op == "min":
+                for p in parts:
+                    acc = jnp.minimum(acc, p)
+            elif op == "prod":
+                for p in parts:
+                    acc = acc * p
+            else:
+                raise ValueError(f"unsupported allreduce op: {op}")
+            self.put_device_object(op_key, acc, consumers=world - 1)
+            return device_objects.device_put_ref(acc)
+        owner0 = tuple(inputs[0].owner_addr)
+        client = self._client_for_worker(owner0)
+        info = self._run(client.call("device_pull_info", op_key,
+                                     wait_s=timeout)).result(timeout)
+        if info is None:
+            raise TimeoutError(
+                f"allreduce rendezvous timed out (rank {rank})")
+        addr, uuid, descs = info
+        arr = DevicePlane.get().pull(addr, uuid, descs)[0]
+        return device_objects.device_put_ref(arr)
 
     # ------------------------------------------------------------------
     # streaming generators (owner side; reference: task_manager.cc
@@ -1511,7 +1748,16 @@ class CoreWorker:
             args, kwargs = await self._resolve_args(spec.args)
             async_method = None
             if spec.is_actor_task:
-                method = getattr(self._actor_instance, spec.method_name)
+                # Compiled-DAG builtins (reference: compiled graphs run
+                # inside a dedicated actor executable loop; ours installs
+                # two worker-provided methods instead).
+                if spec.method_name == "rt_dag_call":
+                    method = self._builtin_dag_call
+                elif spec.method_name == "rt_dag_allreduce":
+                    method = self._builtin_dag_allreduce
+                else:
+                    method = getattr(self._actor_instance,
+                                     spec.method_name)
                 import inspect as _inspect
                 if _inspect.iscoroutinefunction(method):
                     async_method = method
@@ -1561,9 +1807,7 @@ class CoreWorker:
         returns = []
         for i, value in enumerate(results):
             sv = serialization.serialize(value)
-            ref_descs = [(r.binary(),
-                          tuple(r.owner_addr) if r.owner_addr else None)
-                         for r in sv.contained_refs]
+            ref_descs = _ref_descs(sv)
             await self._hold_reply_refs(spec.task_id, sv.contained_refs)
             oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
             if sv.total_size <= GlobalConfig.max_direct_call_object_size:
@@ -1699,9 +1943,7 @@ class CoreWorker:
                                 index: int, sv) -> bool:
         """Report one yielded item to the owner; False = consumer gone."""
         hold_key = (spec.task_id, index)
-        ref_descs = [(r.binary(),
-                      tuple(r.owner_addr) if r.owner_addr else None)
-                     for r in sv.contained_refs]
+        ref_descs = _ref_descs(sv)
         await self._hold_reply_refs(hold_key, sv.contained_refs)
         try:
             if sv.total_size <= GlobalConfig.max_direct_call_object_size:

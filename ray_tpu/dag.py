@@ -3,25 +3,30 @@
 Analogue of the reference's compiled graphs (reference: python/ray/dag/ —
 dag_node.py lazy nodes, input_node.py InputNode, output_node.py
 MultiOutputNode, compiled_dag_node.py CompiledDAG:805 with NCCL channels
-and overlap scheduling). TPU-lite redesign: the lazy ``bind`` API is kept
-verbatim; compilation topologically sorts the graph ONCE and replays it
-per execute() with direct pipelined actor pushes and ObjectRef plumbing —
-activation handoffs between actors ride the runtime's direct
-worker-to-worker object path instead of NCCL channels (intra-host shm;
-the ICI device-channel fast path is device_objects.DeviceRef). For
-in-graph device-to-device tensors, combine with
-``ray_tpu.device_objects`` refs as values.
+and overlap scheduling; collective_node.py:252 CollectiveOutputNode). TPU
+redesign: the lazy ``bind`` API is kept verbatim; compilation
+topologically sorts the graph ONCE and replays it per execute() with
+direct pipelined actor pushes and ObjectRef plumbing. Edges marked
+``.with_tensor_transport()`` move their tensors over the DEVICE plane:
+the producer keeps the array in HBM and ships a tiny DeviceRef; the
+consumer pulls it device-to-device through the PJRT transfer server (DMA
+on TPU) — no host pickle round-trip. ``allreduce([...])`` is the in-DAG
+collective node.
 
     with InputNode() as inp:
-        x = preproc.run.bind(inp)
+        x = preproc.run.bind(inp).with_tensor_transport()
         y = model.forward.bind(x)
         dag = MultiOutputNode([y, postproc.run.bind(y)])
     compiled = dag.experimental_compile()
     out_refs = compiled.execute(batch)
+
+    # in-DAG collective: one output per participating actor
+    outs = allreduce([w1.grad.bind(inp), w2.grad.bind(inp)], op="mean")
 """
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional
 
 
@@ -84,9 +89,49 @@ class ClassMethodNode(DAGNode):
         self.method_name = method_name
         self.args = args
         self.kwargs = kwargs
+        self.tensor_transport = False
         found: List[DAGNode] = []
         _scan_nodes(list(args) + list(kwargs.values()), found)
         self._upstream.extend(found)
+
+    def with_tensor_transport(self, transport: str = "auto"
+                              ) -> "ClassMethodNode":
+        """Keep this node's output in device memory: downstream nodes
+        receive it device-to-device over the transfer plane instead of
+        through the host object path (reference:
+        dag_node.py with_tensor_transport / TorchTensorType hints)."""
+        self.tensor_transport = True
+        return self
+
+
+class AllReduceNode(DAGNode):
+    """One participant's output of an in-DAG allreduce (reference:
+    dag/collective_node.py:252 CollectiveOutputNode). Created via
+    `allreduce(nodes, op)`; executes on the same actor as its input."""
+
+    def __init__(self, input_node: ClassMethodNode, rank: int,
+                 group: List[ClassMethodNode], op: str):
+        super().__init__()
+        self.input_node = input_node
+        self.rank = rank
+        self.group = group
+        self.op = op
+        self._upstream = list(group)  # needs every participant's tensor
+
+
+def allreduce(nodes: List[ClassMethodNode],
+              op: str = "sum") -> List[AllReduceNode]:
+    """Bind an allreduce across the outputs of `nodes` (one per actor).
+    Returns one AllReduceNode per participant, each device-resident on
+    its actor. Inputs are auto-marked for tensor transport."""
+    if not nodes:
+        raise ValueError("allreduce needs at least one input node")
+    for n in nodes:
+        if not isinstance(n, ClassMethodNode):
+            raise TypeError("allreduce inputs must be actor-method nodes")
+        n.with_tensor_transport()
+    group = list(nodes)  # ONE shared list: execute() keys the op by it
+    return [AllReduceNode(n, i, group, op) for i, n in enumerate(nodes)]
 
 
 class MultiOutputNode(DAGNode):
@@ -128,7 +173,10 @@ class CompiledDAG:
             if len(args) != 1:
                 raise TypeError(
                     f"DAG takes exactly 1 input, got {len(args)}")
+        from ray_tpu.core.ref import ActorMethod
+
         values: Dict[int, Any] = {}
+        op_keys: Dict[int, bytes] = {}  # allreduce group -> this round's key
         for node in self._order:
             if isinstance(node, InputNode):
                 values[id(node)] = args[0]
@@ -136,8 +184,32 @@ class CompiledDAG:
                 call_args = [_substitute(a, values) for a in node.args]
                 call_kwargs = {k: _substitute(v, values)
                                for k, v in node.kwargs.items()}
-                method = getattr(node.actor, node.method_name)
-                values[id(node)] = method.remote(*call_args, **call_kwargs)
+                device_in = any(
+                    isinstance(up, (ClassMethodNode, AllReduceNode))
+                    and getattr(up, "tensor_transport", True)
+                    for up in node._upstream)
+                if node.tensor_transport or device_in:
+                    # Device-plane edge: run through the worker builtin
+                    # that unwraps DeviceRef args (device-to-device pull)
+                    # and/or keeps the output in HBM.
+                    out_mode = "device" if node.tensor_transport else "host"
+                    method = ActorMethod(node.actor, "rt_dag_call")
+                    values[id(node)] = method.remote(
+                        node.method_name, out_mode, *call_args,
+                        **call_kwargs)
+                else:
+                    method = getattr(node.actor, node.method_name)
+                    values[id(node)] = method.remote(*call_args,
+                                                     **call_kwargs)
+            elif isinstance(node, AllReduceNode):
+                key = op_keys.get(id(node.group))
+                if key is None:
+                    key = op_keys[id(node.group)] = os.urandom(16)
+                inputs = [values[id(n)] for n in node.group]
+                method = ActorMethod(node.input_node.actor,
+                                     "rt_dag_allreduce")
+                values[id(node)] = method.remote(
+                    key, node.rank, len(node.group), node.op, inputs)
             elif isinstance(node, MultiOutputNode):
                 values[id(node)] = [values[id(o)] for o in node.outputs]
             else:

@@ -8,77 +8,118 @@ TPU-native shape:
 
     ref = device_put_ref(jax_array)        # stays in this process's HBM
     # ... ship `ref` through actor calls / task args (tiny metadata) ...
-    arr = device_get(ref)                  # owner->here transfer, then
-                                           # host->device onto local chips
+    arr = device_get(ref)                  # device-to-device pull through
+                                           # the transfer plane
 
-Transfer rides the core-worker RPC plane as host bytes (the DCN-equivalent
-path); intra-slice ICI device-to-device via the jax transfer server is the
-planned fast path. free_ref() drops the owner's HBM reference.
+Ownership rides the ObjectRef protocol: a DeviceRef wraps a real
+ObjectRef, so serializing it inside values registers borrows, and the
+HBM array frees automatically when the last reference anywhere drops
+(core_worker frees the device twin with the ledger entry). free_ref()
+remains as an explicit early-free.
+
+Transfers are device-to-device through the PJRT transfer plane
+(experimental/device_plane.py — DMA over ICI/DCN on TPU); the host-bytes
+RPC path survives only as a cross-backend fallback.
 """
 
 from __future__ import annotations
 
-import os
 from typing import Any, Optional
 
-from ray_tpu.core.ref import get_core_worker
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.ref import ObjectRef, get_core_worker
 
 
 class DeviceRef:
-    """Handle to an array resident on its owner process's devices."""
+    """Handle to an array resident on its owner process's devices.
 
-    __slots__ = ("owner_addr", "key", "shape", "dtype")
+    Wraps an ObjectRef (`.ref`) so reference counting, borrows, and
+    owner-death cleanup work exactly like host objects."""
 
-    def __init__(self, owner_addr, key: bytes, shape, dtype: str):
-        self.owner_addr = tuple(owner_addr)
-        self.key = key
+    __slots__ = ("ref", "shape", "dtype")
+
+    def __init__(self, ref: ObjectRef, shape, dtype: str):
+        self.ref = ref
         self.shape = tuple(shape)
         self.dtype = dtype
 
+    @property
+    def owner_addr(self):
+        return self.ref.owner_addr
+
+    @property
+    def key(self) -> bytes:
+        return self.ref.binary()
+
     def __reduce__(self):
-        return (DeviceRef, (self.owner_addr, self.key, self.shape,
-                            self.dtype))
+        # Pickling recurses into self.ref -> ObjectRef.__reduce__ ->
+        # note_contained_ref: borrower accounting comes for free.
+        return (DeviceRef, (self.ref, self.shape, self.dtype))
 
     def __repr__(self):
-        return (f"DeviceRef({self.key.hex()[:8]}, shape={self.shape}, "
+        return (f"DeviceRef({self.ref.hex()[:12]}, shape={self.shape}, "
                 f"dtype={self.dtype}, owner={self.owner_addr})")
 
 
 def device_put_ref(array: Any) -> DeviceRef:
     """Register a (jax) array as device-resident in THIS process; the
-    returned ref is cheap to pass around the cluster."""
+    returned ref is cheap to pass around the cluster and frees the HBM
+    array when the last copy drops."""
     cw = get_core_worker()
-    key = os.urandom(16)
-    cw.put_device_object(key, array)
-    return DeviceRef(cw.address, key, getattr(array, "shape", ()),
+    oid = ObjectID.from_put()
+    ref = ObjectRef(oid, cw.address)
+    cw.add_local_ref(ref)
+    cw.put_device_object(oid.binary(), array)
+    # Ledger entry: a tiny READY marker so get/wait/refcount see a normal
+    # owned object; the array itself lives in the device table.
+    from ray_tpu.core import serialization
+    sv = serialization.serialize({"__device_marker__": True})
+    cw._run(cw._do_put(oid.binary(), sv)).result()
+    return DeviceRef(ref, getattr(array, "shape", ()),
                      str(getattr(array, "dtype", "float32")))
 
 
 def device_get(ref: DeviceRef, *, sharding: Optional[Any] = None,
                timeout: float = 120.0) -> Any:
     """Materialize the array locally. Same-process: zero-copy handle.
-    Remote: out-of-band fetch from the owner, then jax.device_put
-    (optionally with a target sharding)."""
-    import numpy as np
+    Remote: device-to-device pull via the transfer plane (host-bytes RPC
+    only as a cross-backend fallback), then optional resharding."""
+    import jax
 
     cw = get_core_worker()
-    if tuple(ref.owner_addr) == cw.address:
-        local = cw.get_device_object_local(ref.key)
+    key = ref.key
+    if ref.owner_addr is None or tuple(ref.owner_addr) == cw.address:
+        local = cw.get_device_object_local(key)
         if local is None:
             raise KeyError(f"device object freed: {ref}")
         if sharding is not None:  # honor the contract on BOTH paths
-            import jax
             return jax.device_put(local, sharding)
         return local
-    client = cw._client_for_worker(ref.owner_addr)
-    got = cw._run(client.call("fetch_device_object",
-                              ref.key)).result(timeout)
+    client = cw._client_for_worker(tuple(ref.owner_addr))
+    try:
+        info = cw._run(client.call("device_pull_info", key,
+                                   wait_s=0.0)).result(timeout)
+    except Exception:
+        # Owner can't stage (e.g. no transfer plane on its backend):
+        # the host-bytes endpoint below still works.
+        info = None
+    if info is not None:
+        from ray_tpu.experimental.device_plane import DevicePlane
+        addr, uuid, descs = info
+        try:
+            arr = DevicePlane.get().pull(addr, uuid, descs)[0]
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            return arr
+        except Exception:
+            pass  # backend mismatch: fall through to host bytes
+    import numpy as np
+    got = cw._run(client.call("fetch_device_object", key)).result(timeout)
     if got is None:
         raise KeyError(f"device object freed on owner: {ref}")
     data, _dtype, _shape = got  # pickle-5 already rebuilt the ndarray
     host = np.asarray(data)
     try:
-        import jax
         return jax.device_put(host, sharding) if sharding is not None \
             else jax.device_put(host)
     except Exception:
@@ -86,13 +127,15 @@ def device_get(ref: DeviceRef, *, sharding: Optional[Any] = None,
 
 
 def free_ref(ref: DeviceRef) -> None:
-    """Drop the owner's HBM reference (idempotent)."""
+    """Explicitly drop the owner's HBM array now (idempotent). The
+    ledger entry still follows normal refcounting."""
     cw = get_core_worker()
-    if tuple(ref.owner_addr) == cw.address:
+    if ref.owner_addr is None or tuple(ref.owner_addr) == cw.address:
         cw.free_device_object(ref.key)
         return
-    client = cw._client_for_worker(ref.owner_addr)
+    client = cw._client_for_worker(tuple(ref.owner_addr))
     try:
-        cw._run(client.call("free_device_object_remote", ref.key)).result(30)
+        cw._run(client.call("free_device_object_remote",
+                            ref.key)).result(30)
     except Exception:
         pass

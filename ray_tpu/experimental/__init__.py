@@ -1,0 +1,4 @@
+"""Experimental accelerator-plane features: device channels, DAG tensor
+transport (reference: python/ray/experimental/channel/)."""
+
+from ray_tpu.experimental.channel import DeviceChannel  # noqa: F401

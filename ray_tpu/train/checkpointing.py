@@ -85,12 +85,14 @@ def save_checkpoint(directory: str, state: Any, step: int,
     import jax
 
     proc = jax.process_index()
-    ckpt_dir = os.path.join(directory, f"step-{step}")
-    # A prior partial/crashed save of the same step may have left shard
-    # files for a *different* topology behind; merging them with fresh
-    # shards would corrupt the checkpoint. Process 0 clears the dir, then
-    # everyone waits before writing (atomicity also backstopped by the
-    # exact shard manifest recorded in _METADATA.json below).
+    final_dir = os.path.join(directory, f"step-{step}")
+    # All writes land in a TEMP dir; the committed dir is replaced by an
+    # atomic swap at the very end. Two guarantees: (a) a crashed save
+    # never mixes stale shards into a later save of the same step
+    # (backstopped by the exact shard manifest in _METADATA.json too);
+    # (b) an existing COMMITTED step-N stays restorable until the new
+    # save is fully durable.
+    ckpt_dir = os.path.join(directory, f"_tmp-step-{step}")
     if proc == 0 and os.path.isdir(ckpt_dir):
         import shutil
         shutil.rmtree(ckpt_dir, ignore_errors=True)
@@ -140,10 +142,21 @@ def save_checkpoint(directory: str, state: Any, step: int,
             json.dump(meta, f)
         with open(os.path.join(ckpt_dir, "COMMIT"), "w") as f:
             f.write("ok")
+        # Atomic swap: the committed temp dir replaces any prior step-N.
+        # A crash before this point leaves the previous committed
+        # checkpoint untouched; the rename pair's window is microseconds
+        # (vs. the whole shard-write window if we cleared in place).
+        import shutil
+        trash = os.path.join(directory, f"_trash-step-{step}")
+        shutil.rmtree(trash, ignore_errors=True)
+        if os.path.isdir(final_dir):
+            os.rename(final_dir, trash)
+        os.rename(ckpt_dir, final_dir)
+        shutil.rmtree(trash, ignore_errors=True)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt-visible-{step}")
-    return Checkpoint(ckpt_dir, step, metrics)
+    return Checkpoint(final_dir, step, metrics)
 
 
 def restore_checkpoint(ckpt: "Checkpoint | str", target: Any) -> Any:
