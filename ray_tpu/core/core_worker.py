@@ -471,10 +471,7 @@ class CoreWorker:
                 if self._device_tokens.get(key) is token:
                     self.free_device_object(key)
 
-            self._run(self._spawn_coro(_ttl_free()))
-
-    async def _spawn_coro(self, coro) -> None:
-        spawn(coro)
+            self._spawn(_ttl_free())
 
     def get_device_object_local(self, key: bytes) -> Any:
         return self._device_objects.get(key)
@@ -812,6 +809,19 @@ class CoreWorker:
         self.add_local_ref(ref)
         self._run(self._do_put(oid.binary(), sv)).result()
         return ref
+
+    def put_inline_marker(self, oid: bytes, sv) -> None:
+        """Synchronously register a small ref-free owned object (e.g. a
+        DeviceRef's ledger marker). Safe from ANY thread for a FRESH oid:
+        nobody can be waiting on it yet, so no cross-thread event fires —
+        which also makes it safe on the io loop itself, where blocking on
+        _run(_do_put) would deadlock."""
+        assert not sv.contained_refs and \
+            sv.total_size <= GlobalConfig.max_direct_call_object_size
+        e = self._entry(oid, create=True)
+        e.creating_task = None
+        e.contained = []
+        self._mark_ready_inline(oid, sv.to_bytes(), sv.meta())
 
     async def _do_put(self, oid: bytes, sv) -> None:
         e = self._entry(oid, create=True)
@@ -1751,9 +1761,9 @@ class CoreWorker:
                 # Compiled-DAG builtins (reference: compiled graphs run
                 # inside a dedicated actor executable loop; ours installs
                 # two worker-provided methods instead).
-                if spec.method_name == "rt_dag_call":
+                if spec.method_name == "__rt_dag_call__":
                     method = self._builtin_dag_call
-                elif spec.method_name == "rt_dag_allreduce":
+                elif spec.method_name == "__rt_dag_allreduce__":
                     method = self._builtin_dag_allreduce
                 else:
                     method = getattr(self._actor_instance,

@@ -193,7 +193,7 @@ class CompiledDAG:
                     # that unwraps DeviceRef args (device-to-device pull)
                     # and/or keeps the output in HBM.
                     out_mode = "device" if node.tensor_transport else "host"
-                    method = ActorMethod(node.actor, "rt_dag_call")
+                    method = ActorMethod(node.actor, "__rt_dag_call__")
                     values[id(node)] = method.remote(
                         node.method_name, out_mode, *call_args,
                         **call_kwargs)
@@ -207,7 +207,7 @@ class CompiledDAG:
                     key = op_keys[id(node.group)] = os.urandom(16)
                 inputs = [values[id(n)] for n in node.group]
                 method = ActorMethod(node.input_node.actor,
-                                     "rt_dag_allreduce")
+                                     "__rt_dag_allreduce__")
                 values[id(node)] = method.remote(
                     key, node.rank, len(node.group), node.op, inputs)
             elif isinstance(node, MultiOutputNode):

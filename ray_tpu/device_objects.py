@@ -71,10 +71,12 @@ def device_put_ref(array: Any) -> DeviceRef:
     cw.add_local_ref(ref)
     cw.put_device_object(oid.binary(), array)
     # Ledger entry: a tiny READY marker so get/wait/refcount see a normal
-    # owned object; the array itself lives in the device table.
+    # owned object; the array itself lives in the device table. Registered
+    # synchronously (callable from exec threads AND async actor methods
+    # running on the io loop).
     from ray_tpu.core import serialization
     sv = serialization.serialize({"__device_marker__": True})
-    cw._run(cw._do_put(oid.binary(), sv)).result()
+    cw.put_inline_marker(oid.binary(), sv)
     return DeviceRef(ref, getattr(array, "shape", ()),
                      str(getattr(array, "dtype", "float32")))
 
@@ -111,8 +113,14 @@ def device_get(ref: DeviceRef, *, sharding: Optional[Any] = None,
             if sharding is not None:
                 arr = jax.device_put(arr, sharding)
             return arr
-        except Exception:
-            pass  # backend mismatch: fall through to host bytes
+        except Exception as e:
+            # Fall through to host bytes, but LOUDLY: a deployment whose
+            # fast path never works (bad RAY_TPU_NODE_IP, backend
+            # mismatch) must not silently run at host-copy speed.
+            from ray_tpu.utils import get_logger
+            get_logger("device_objects").warning(
+                "device-plane pull from %s failed (%r); falling back to "
+                "host-bytes transfer", addr, e)
     import numpy as np
     got = cw._run(client.call("fetch_device_object", key)).result(timeout)
     if got is None:

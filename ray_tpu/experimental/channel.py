@@ -76,6 +76,10 @@ class DeviceChannel:
         addrs = [_resolve_reader_addr(r) for r in readers]
         if not addrs:
             raise ValueError("a channel needs at least one reader")
+        if len(set(addrs)) != len(addrs):
+            # Acks key by reader address; duplicates would make the
+            # writer's release barrier unsatisfiable (permanent timeout).
+            raise ValueError("duplicate reader processes in channel")
         return DeviceChannel(os.urandom(16), addrs, capacity)
 
     def __reduce__(self):
@@ -103,6 +107,9 @@ class DeviceChannel:
                 len(self.reader_addrs), timeout)).result()
         st.seq = n
         plane = DevicePlane.get()
+        # Reform once (a sharded value gathers to one device here);
+        # staging per reader below is then copy-free.
+        value = plane._pullable(value)
         for reader in self.reader_addrs:
             # One staged ticket per reader: each pull consumes a ticket.
             addr, uuid, descs = plane.stage([value])

@@ -89,7 +89,12 @@ class DevicePlane:
 
     def stage(self, arrays: List[Any]) -> Tuple[str, int, list]:
         """Make arrays pullable by ONE remote peer. Returns
-        (address, uuid, aval_descs) — the tiny control-plane tuple."""
+        (address, uuid, aval_descs) — the tiny control-plane tuple.
+
+        Constraint: the PJRT transfer server exposes no unstage/cancel,
+        so a ticket whose peer never pulls (peer death, failed pull that
+        fell back to host bytes) pins its array until the server is
+        dropped — callers should treat staging as committed-to-a-pull."""
         import jax
         import numpy as np
 
