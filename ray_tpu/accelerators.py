@@ -106,6 +106,30 @@ def node_labels() -> Dict[str, str]:
     return labels
 
 
+def reserve_tpu_slice(num_hosts: int,
+                      resources_per_host: Optional[Dict[str, float]] = None,
+                      *, accelerator_type_filter: str = "",
+                      strategy: str = "STRICT_SPREAD"):
+    """Atomically reserve `num_hosts` worker nodes of ONE TPU slice as a
+    placement group (reference: python/ray/_private/accelerators/tpu.py:145
+    reserve_tpu_slice + train/v2/.../tpu_reservation_callback.py:9).
+
+    All bundles are constrained to nodes sharing one slice-name label
+    ("$same" gang), so the reservation either lands entirely on a single
+    slice or stays pending — multi-host gang scheduling can then target
+    the PG's bundles one-per-host.
+    """
+    import ray_tpu
+
+    bundle = dict(resources_per_host or {"TPU": 4.0})
+    selector: Dict[str, str] = {TPU_SLICE_NAME_LABEL: "$same"}
+    if accelerator_type_filter:
+        selector[TPU_ACCELERATOR_TYPE_LABEL] = accelerator_type_filter
+    return ray_tpu.placement_group(
+        [dict(bundle) for _ in range(num_hosts)], strategy=strategy,
+        bundle_label_selector=[dict(selector) for _ in range(num_hosts)])
+
+
 def worker_env_for_chips(chip_ids: List[int]) -> Dict[str, str]:
     """Env vars that scope a spawned worker process to specific chips
     (reference: tpu.py set_current_process_visible_accelerator_ids →

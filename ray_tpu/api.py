@@ -113,6 +113,7 @@ class RemoteFunction:
             placement_group=_pg_id(opts.get("placement_group")),
             pg_bundle_index=opts.get("placement_group_bundle_index", -1),
             scheduling_strategy=opts.get("scheduling_strategy"),
+            label_selector=opts.get("label_selector"),
             name=opts.get("name", ""))
         if num_returns == "streaming":
             return refs  # an ObjectRefGenerator
@@ -145,7 +146,8 @@ class ActorClass:
             resources=_resources_from_opts(opts, default_cpu=0.0),
             placement_group=_pg_id(opts.get("placement_group")),
             pg_bundle_index=opts.get("placement_group_bundle_index", -1),
-            runtime_env=opts.get("runtime_env"))
+            runtime_env=opts.get("runtime_env"),
+            label_selector=opts.get("label_selector"))
 
     def options(self, **opts):
         merged = dict(self._opts)
@@ -253,12 +255,23 @@ def _pg_id(pg) -> Optional[bytes]:
 
 
 def placement_group(bundles: List[Dict[str, float]],
-                    strategy: str = "PACK") -> PlacementGroup:
+                    strategy: str = "PACK",
+                    bundle_label_selector: Optional[List[dict]] = None
+                    ) -> PlacementGroup:
+    """bundle_label_selector: one node-label selector per bundle
+    (reference: label_selector.cc operators — "v", "!v", "in(a,b)",
+    "!in(a,b)"); the special value "$same" gangs all such bundles onto
+    nodes sharing one value of that label, all-or-nothing (TPU
+    slice-atomic reservation)."""
+    if bundle_label_selector is not None and \
+            len(bundle_label_selector) != len(bundles):
+        raise ValueError("bundle_label_selector must have one entry "
+                         "per bundle")
     cw = _cw()
     pg_id = PlacementGroupID.random()
     cw._run(cw.controller.call(
         "create_placement_group", pg_id.binary(), bundles,
-        strategy)).result()
+        strategy, bundle_label_selector)).result()
     return PlacementGroup(pg_id, bundles)
 
 

@@ -39,6 +39,37 @@ def resources_add(avail: Dict[str, float], need: Dict[str, float]) -> None:
             avail[k] = avail.get(k, 0.0) + v
 
 
+def labels_match(labels: Dict[str, str], selector: Optional[Dict[str, str]]
+                 ) -> bool:
+    """Node-label selector matching (reference:
+    src/ray/common/scheduling/label_selector.cc — equals / not-equals /
+    in / not-in operators encoded in the value string):
+
+        {"zone": "us1"}            zone == us1
+        {"zone": "!us1"}           zone != us1
+        {"zone": "in(us1,us2)"}    zone in {us1, us2}
+        {"zone": "!in(us1,us2)"}   zone not in {us1, us2}
+
+    A missing label never satisfies a positive match and always
+    satisfies a negative one.
+    """
+    if not selector:
+        return True
+    for key, want in selector.items():
+        have = labels.get(key)
+        neg = want.startswith("!")
+        if neg:
+            want = want[1:]
+        if want.startswith("in(") and want.endswith(")"):
+            hit = have is not None and have in [
+                v.strip() for v in want[3:-1].split(",")]
+        else:
+            hit = have == want
+        if hit if neg else not hit:
+            return False
+    return True
+
+
 # --- task spec -------------------------------------------------------------
 
 @dataclasses.dataclass
@@ -69,6 +100,7 @@ class TaskSpec:
     placement_group: Optional[bytes] = None
     pg_bundle_index: int = -1
     scheduling_strategy: Optional[Any] = None  # e.g. NodeAffinity
+    label_selector: Optional[dict] = None      # node-label constraints
     runtime_env: Optional[dict] = None
 
     @property

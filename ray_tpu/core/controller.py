@@ -25,7 +25,8 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.common import (ActorState, Address, NodeState, PGState,
-                                 resources_add, resources_fit, resources_sub)
+                                 labels_match, resources_add, resources_fit,
+                                 resources_sub)
 from ray_tpu.core.pubsub import PubsubHub
 from ray_tpu.core.rpc import RpcClient, RpcServer, long_poll
 from ray_tpu.utils import get_logger
@@ -52,7 +53,8 @@ class ActorEntry:
     def __init__(self, actor_id: bytes, spec_blob: bytes, name: str,
                  max_restarts: int, resources: Dict[str, float],
                  placement: Optional[Tuple[bytes, int]],
-                 runtime_env: Optional[dict] = None):
+                 runtime_env: Optional[dict] = None,
+                 label_selector: Optional[Dict[str, str]] = None):
         self.actor_id = actor_id
         self.spec_blob = spec_blob
         self.name = name
@@ -61,6 +63,7 @@ class ActorEntry:
         self.resources = resources
         self.placement = placement
         self.runtime_env = runtime_env or {}
+        self.label_selector = label_selector
         self.state = ActorState.PENDING
         self.addr: Optional[Address] = None
         self.node_id: Optional[bytes] = None
@@ -70,10 +73,15 @@ class ActorEntry:
 
 class PGEntry:
     def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]],
-                 strategy: str):
+                 strategy: str,
+                 bundle_label_selector: Optional[List[dict]] = None):
         self.pg_id = pg_id
         self.bundles = bundles
         self.strategy = strategy
+        # Per-bundle node-label constraints; the special value "$same"
+        # gangs bundles onto nodes sharing ONE value for that key (slice-
+        # atomic reservation, reference: tpu.py:145 reserve_tpu_slice).
+        self.bundle_label_selector = bundle_label_selector
         self.state = PGState.PENDING
         self.bundle_nodes: List[Optional[bytes]] = [None] * len(bundles)
         self.event = asyncio.Event()
@@ -139,7 +147,8 @@ class Controller:
         for a in snap.get("actors", []):
             entry = ActorEntry(a["actor_id"], a["spec_blob"], a["name"],
                                a["max_restarts"], a["resources"],
-                               a["placement"], a["runtime_env"])
+                               a["placement"], a["runtime_env"],
+                               a.get("label_selector"))
             entry.state = a["state"]
             entry.addr = a["addr"]
             entry.node_id = a["node_id"]
@@ -149,7 +158,8 @@ class Controller:
                 entry.event.set()
             self.actors[a["actor_id"]] = entry
         for p in snap.get("pgs", []):
-            pg = PGEntry(p["pg_id"], p["bundles"], p["strategy"])
+            pg = PGEntry(p["pg_id"], p["bundles"], p["strategy"],
+                         p.get("bundle_label_selector"))
             pg.state = p["state"]
             pg.bundle_nodes = p["bundle_nodes"]
             if pg.state != PGState.PENDING:
@@ -172,7 +182,8 @@ class Controller:
                 "actor_id": e.actor_id, "spec_blob": e.spec_blob,
                 "name": e.name, "max_restarts": e.max_restarts,
                 "resources": e.resources, "placement": e.placement,
-                "runtime_env": e.runtime_env, "state": e.state,
+                "runtime_env": e.runtime_env,
+                "label_selector": e.label_selector, "state": e.state,
                 "addr": e.addr, "node_id": e.node_id,
                 "restarts_used": e.restarts_used,
                 "death_reason": e.death_reason,
@@ -181,6 +192,7 @@ class Controller:
                 "pg_id": p.pg_id, "bundles": p.bundles,
                 "strategy": p.strategy, "state": p.state,
                 "bundle_nodes": p.bundle_nodes,
+                "bundle_label_selector": p.bundle_label_selector,
             } for p in self.pgs.values()],
         }
         tmp = self._storage_path + ".tmp"
@@ -373,9 +385,12 @@ class Controller:
 
     def _pick(self, resources: Dict[str, float],
               exclude: Optional[set] = None,
-              strategy: Optional[Any] = None) -> Optional[NodeEntry]:
+              strategy: Optional[Any] = None,
+              label_selector: Optional[dict] = None
+              ) -> Optional[NodeEntry]:
         nodes = [n for n in self._alive_nodes()
-                 if not exclude or n.node_id not in exclude]
+                 if (not exclude or n.node_id not in exclude)
+                 and labels_match(n.labels, label_selector)]
         if strategy is not None:
             kind = strategy.get("kind") if isinstance(strategy, dict) else None
             if kind == "node_affinity":
@@ -386,7 +401,7 @@ class Controller:
                                 strategy.get("soft"):
                             return n
                 return None if not strategy.get("soft") else (
-                    self._pick(resources, exclude, None))
+                    self._pick(resources, exclude, None, label_selector))
             if kind == "spread":
                 fitting = [n for n in nodes
                            if resources_fit(n.resources_available, resources)]
@@ -413,9 +428,10 @@ class Controller:
         return max(pool, key=utilization)
 
     async def pick_node(self, resources: dict, exclude=None,
-                        strategy=None) -> Optional[dict]:
+                        strategy=None,
+                        label_selector=None) -> Optional[dict]:
         exclude = set(exclude) if exclude else None
-        node = self._pick(resources, exclude, strategy)
+        node = self._pick(resources, exclude, strategy, label_selector)
         if node is None:
             # Unsatisfiable demand: the autoscaler's scale-up signal
             # (reference: gcs_autoscaler_state_manager.cc aggregates
@@ -457,14 +473,15 @@ class Controller:
     async def create_actor(self, actor_id: bytes, spec_blob: bytes, name: str,
                            max_restarts: int, resources: dict,
                            placement=None, detached: bool = False,
-                           runtime_env: Optional[dict] = None) -> dict:
+                           runtime_env: Optional[dict] = None,
+                           label_selector: Optional[dict] = None) -> dict:
         if name:
             if name in self.named_actors:
                 raise ValueError(f"actor name already taken: {name!r}")
             self.named_actors[name] = actor_id
         entry = ActorEntry(actor_id, spec_blob, name, max_restarts, resources,
                            tuple(placement) if placement else None,
-                           runtime_env)
+                           runtime_env, label_selector)
         self.actors[actor_id] = entry
         self._mark_dirty()
         spawn(self._schedule_actor(entry))
@@ -480,7 +497,8 @@ class Controller:
                 target = self.nodes.get(node_id)
         attempts = 0
         while attempts < 60:
-            node = target or self._pick(entry.resources)
+            node = target or self._pick(
+                entry.resources, label_selector=entry.label_selector)
             if node is not None:
                 try:
                     reply = await node.client.call(
@@ -602,15 +620,52 @@ class Controller:
     # gcs_placement_group_scheduler.cc prepare/commit)
     # ------------------------------------------------------------------
     async def create_placement_group(self, pg_id: bytes, bundles: list,
-                                     strategy: str) -> dict:
-        pg = PGEntry(pg_id, bundles, strategy)
+                                     strategy: str,
+                                     bundle_label_selector=None) -> dict:
+        # Validate eagerly: an error inside the fire-and-forget scheduler
+        # would leave the PG silently PENDING forever.
+        gang = {k for sel in (bundle_label_selector or []) if sel
+                for k, v in sel.items() if v == "$same"}
+        if len(gang) > 1:
+            raise ValueError("at most one $same gang label per PG")
+        pg = PGEntry(pg_id, bundles, strategy, bundle_label_selector)
         self.pgs[pg_id] = pg
         self._mark_dirty()
         spawn(self._schedule_pg(pg))
         return {"pg_id": pg_id}
 
     def _plan_pg(self, pg: PGEntry) -> Optional[List[NodeEntry]]:
-        """Choose a node per bundle respecting the strategy; None if infeasible."""
+        """Choose a node per bundle respecting the strategy and per-bundle
+        label selectors; None if infeasible. Selector values of "$same"
+        gang all such bundles onto nodes sharing ONE value of that label
+        (all-or-nothing — the slice-atomic reservation primitive,
+        reference: python/ray/_private/accelerators/tpu.py:145)."""
+        selectors = pg.bundle_label_selector or [None] * len(pg.bundles)
+        gang_keys = {k for sel in selectors if sel
+                     for k, v in sel.items() if v == "$same"}
+        if not gang_keys:
+            return self._plan_pg_with(pg, selectors)
+        key = next(iter(gang_keys))  # validated single at creation
+        # Try each concrete value of the ganged label (e.g. each TPU
+        # slice name), most total free capacity first.
+        free: Dict[str, float] = {}
+        for n in self._alive_nodes():
+            v = n.labels.get(key)
+            if v is not None:
+                free[v] = free.get(v, 0.0) + sum(
+                    n.resources_available.values())
+        values = sorted(free, key=lambda v: -free[v])
+        for value in values:
+            bound = [dict(sel, **{key: value}) if sel and sel.get(key)
+                     == "$same" else sel for sel in selectors]
+            plan = self._plan_pg_with(pg, bound)
+            if plan is not None:
+                return plan
+        return None
+
+    def _plan_pg_with(self, pg: PGEntry,
+                      selectors: List[Optional[dict]]
+                      ) -> Optional[List[NodeEntry]]:
         nodes = self._alive_nodes()
         if not nodes:
             return None
@@ -620,6 +675,9 @@ class Controller:
         if pg.strategy in ("STRICT_PACK", "PACK"):
             # Try to fit everything on one node first.
             for n in nodes:
+                if not all(labels_match(n.labels, sel)
+                           for sel in selectors):
+                    continue
                 trial = dict(avail[n.node_id])
                 if all(resources_fit(trial, b) and
                        (resources_sub(trial, b) or True)
@@ -636,6 +694,8 @@ class Controller:
                 [p for p in plan if p.node_id == n.node_id]))
             for n in candidates:
                 if pg.strategy == "STRICT_SPREAD" and n.node_id in used_nodes:
+                    continue
+                if not labels_match(n.labels, selectors[i]):
                     continue
                 if resources_fit(avail[n.node_id], bundle):
                     resources_sub(avail[n.node_id], bundle)

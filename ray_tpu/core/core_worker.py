@@ -1075,7 +1075,8 @@ class CoreWorker:
     def submit_task(self, func, args, kwargs, *, num_returns=1,
                     resources: Optional[dict] = None, max_retries: int = 0,
                     placement_group=None, pg_bundle_index: int = -1,
-                    scheduling_strategy=None, name: str = ""):
+                    scheduling_strategy=None, label_selector=None,
+                    name: str = ""):
         streaming = num_returns == "streaming"
         func_id = self._export_function(func)
         task_id = TaskID.random()
@@ -1094,6 +1095,7 @@ class CoreWorker:
             placement_group=placement_group,
             pg_bundle_index=pg_bundle_index,
             scheduling_strategy=scheduling_strategy,
+            label_selector=label_selector,
         )
         self._task_arg_refs[task_id.binary()] = held
         self._record_task_event(task_id.binary(), spec.name, "submitted")
@@ -1163,8 +1165,10 @@ class CoreWorker:
         strat = spec.scheduling_strategy
         strat_key = tuple(sorted(strat.items())) if isinstance(strat, dict) \
             else strat
+        sel = spec.label_selector
+        sel_key = tuple(sorted(sel.items())) if sel else None
         return (tuple(sorted(spec.resources.items())), spec.placement_group,
-                spec.pg_bundle_index, strat_key)
+                spec.pg_bundle_index, strat_key, sel_key)
 
     async def _submit_once(self, spec: TaskSpec) -> None:
         """Enqueue on the scheduling class; a per-class lease pump feeds
@@ -1215,7 +1219,8 @@ class CoreWorker:
                     *[self.agent.call(
                         "request_lease", spec0.resources,
                         spec0.placement_group, spec0.pg_bundle_index,
-                        spec0.scheduling_strategy) for _ in range(want)],
+                        spec0.scheduling_strategy, spec0.label_selector)
+                      for _ in range(want)],
                     return_exceptions=True)
                 granted = [r for r in results
                            if isinstance(r, dict) and r.get("granted")]
@@ -1453,7 +1458,8 @@ class CoreWorker:
                      resources: Optional[dict] = None, placement_group=None,
                      pg_bundle_index: int = -1,
                      runtime_env: Optional[dict] = None,
-                     max_concurrency: int = 0) -> ActorHandle:
+                     max_concurrency: int = 0,
+                     label_selector: Optional[dict] = None) -> ActorHandle:
         actor_id = ActorID.random()
         self._ensure_actor_sub()
         # Package working_dir/py_modules to the controller KV and rewrite
@@ -1478,7 +1484,8 @@ class CoreWorker:
         self._run(self.controller.call(
             "create_actor", actor_id.binary(), spec_blob, name, max_restarts,
             resources or {"CPU": 1.0}, placement,
-            runtime_env=runtime_env)).result()
+            runtime_env=runtime_env,
+            label_selector=label_selector)).result()
         method_names = [m for m in dir(cls)
                         if not m.startswith("_") and callable(getattr(cls, m))]
         return ActorHandle(actor_id, name or cls.__name__, method_names,

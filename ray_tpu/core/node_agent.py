@@ -29,8 +29,8 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.core.common import (Address, resources_add, resources_fit,
-                                 resources_sub)
+from ray_tpu.core.common import (Address, labels_match, resources_add,
+                                 resources_fit, resources_sub)
 from ray_tpu.core.ids import NodeID, ObjectID
 from ray_tpu.core.object_store import LocalObjectStore
 from ray_tpu.core.pubsub import Subscription
@@ -473,6 +473,7 @@ class NodeAgent:
     @long_poll
     async def request_lease(self, resources: dict, pg: Optional[bytes] = None,
                             bundle_index: int = -1, strategy=None,
+                            label_selector: Optional[dict] = None,
                             _no_spill: bool = False) -> dict:
         """Grant a worker lease, parking the request SERVER-SIDE while
         resources are busy (reference: cluster_lease_manager.cc queues leases
@@ -500,8 +501,14 @@ class NodeAgent:
                                                         bundle_index, strategy)
                     return {"granted": False, "retry": True}
 
+            # Label constraints: this node must match to grant locally
+            # (PG tasks inherit their bundle's placement instead).
+            local_ok = pg is not None or labels_match(self.labels,
+                                                      label_selector)
             avail = (self.bundle_available.get((pg, bundle_index))
                      if pg is not None else self.resources_available)
+            if not local_ok:
+                avail = None
             if avail is not None and resources_fit(avail, resources):
                 resources_sub(avail, resources)
                 try:
@@ -522,10 +529,11 @@ class NodeAgent:
                 # Spillback: ask the controller for a feasible node.
                 pick = await self.controller.call("pick_node", resources,
                                                   [self.node_id.binary()],
-                                                  strategy)
+                                                  strategy, label_selector)
                 if pick is not None:
                     return await self._spill_to(tuple(pick["addr"]), resources,
-                                                pg, bundle_index, strategy)
+                                                pg, bundle_index, strategy,
+                                                label_selector)
             # Nothing feasible now: park on the resource condvar until
             # something frees up or the queue-wait budget expires.
             if not await self._park_until(deadline):
@@ -549,10 +557,10 @@ class NodeAgent:
         return True
 
     async def _spill_to(self, addr: Address, resources, pg, bundle_index,
-                        strategy) -> dict:
+                        strategy, label_selector=None) -> dict:
         peer = self._peer(addr)
         reply = await peer.call("request_lease", resources, pg, bundle_index,
-                                strategy, _no_spill=True)
+                                strategy, label_selector, _no_spill=True)
         if reply.get("granted"):
             reply["spilled_to"] = addr
         return reply

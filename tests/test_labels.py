@@ -1,0 +1,158 @@
+"""Label-selector scheduling + atomic TPU slice reservation.
+
+Mirrors the reference's label-selector and TPU slice coverage (reference:
+src/ray/common/scheduling/label_selector.cc,
+python/ray/_private/accelerators/tpu.py:145 reserve_tpu_slice,
+python/ray/tests/test_label_selector.py).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+from ray_tpu.core.common import labels_match
+
+
+# ----------------------------------------------------------------------
+# matcher unit tests (no cluster)
+# ----------------------------------------------------------------------
+
+def test_labels_match_operators():
+    labels = {"zone": "us1", "type": "v6e"}
+    assert labels_match(labels, None)
+    assert labels_match(labels, {"zone": "us1"})
+    assert not labels_match(labels, {"zone": "us2"})
+    assert labels_match(labels, {"zone": "!us2"})
+    assert not labels_match(labels, {"zone": "!us1"})
+    assert labels_match(labels, {"zone": "in(us1,us2)"})
+    assert not labels_match(labels, {"zone": "in(us2,us3)"})
+    assert labels_match(labels, {"zone": "!in(us2,us3)"})
+    assert not labels_match(labels, {"zone": "!in(us1,us2)"})
+    # missing label: positive never matches, negative always does
+    assert not labels_match(labels, {"rack": "a"})
+    assert labels_match(labels, {"rack": "!a"})
+    assert not labels_match(labels, {"rack": "in(a,b)"})
+    assert labels_match(labels, {"zone": "us1", "type": "v6e"})
+    assert not labels_match(labels, {"zone": "us1", "type": "v5p"})
+
+
+# ----------------------------------------------------------------------
+# cluster: 2 nodes in slice-A, 1 node in slice-B
+# ----------------------------------------------------------------------
+
+SLICE = "ray_tpu.io/tpu-slice-name"
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(num_nodes=1, resources={"CPU": 2})  # driver node, no labels
+    c.add_node(resources={"CPU": 2}, labels={SLICE: "slice-a", "zone": "z1"})
+    c.add_node(resources={"CPU": 2}, labels={SLICE: "slice-a", "zone": "z2"})
+    c.add_node(resources={"CPU": 2}, labels={SLICE: "slice-b", "zone": "z1"})
+    c.connect()
+    # Wait for all nodes to register.
+    deadline = time.time() + 30
+    while time.time() < deadline and len(ray_tpu.nodes()) < 4:
+        time.sleep(0.2)
+    assert len(ray_tpu.nodes()) == 4
+    yield c
+    c.shutdown()
+
+
+@ray_tpu.remote
+def where():
+    return os.environ["RAY_TPU_NODE_ID"]
+
+
+def _pg_info(pg):
+    from ray_tpu.api import _cw
+    cw = _cw()
+    return cw._run(cw.controller.call("get_pg_info",
+                                      pg.id.binary())).result()
+
+
+def _nodes_by_label(key, value):
+    return {n["node_id"].hex() for n in ray_tpu.nodes()
+            if n["labels"].get(key) == value}
+
+
+def test_task_label_selector(cluster):
+    slice_a = _nodes_by_label(SLICE, "slice-a")
+    slice_b = _nodes_by_label(SLICE, "slice-b")
+    for _ in range(4):
+        nid = ray_tpu.get(where.options(
+            label_selector={SLICE: "slice-a"}).remote())
+        assert nid in slice_a and nid not in slice_b
+    nid = ray_tpu.get(where.options(
+        label_selector={SLICE: "slice-b"}).remote())
+    assert nid in slice_b
+
+
+def test_task_label_selector_negation(cluster):
+    unlabeled_or_b = {n["node_id"].hex() for n in ray_tpu.nodes()
+                      if n["labels"].get(SLICE) != "slice-a"}
+    for _ in range(3):
+        nid = ray_tpu.get(where.options(
+            label_selector={SLICE: "!slice-a"}).remote())
+        assert nid in unlabeled_or_b
+
+
+@ray_tpu.remote
+class Locator:
+    def where(self):
+        return os.environ["RAY_TPU_NODE_ID"]
+
+
+def test_actor_label_selector(cluster):
+    slice_b = _nodes_by_label(SLICE, "slice-b")
+    a = Locator.options(num_cpus=1,
+                        label_selector={SLICE: "slice-b"}).remote()
+    nid = ray_tpu.get(a.where.remote())
+    assert nid in slice_b
+    ray_tpu.kill(a)
+
+
+def test_pg_bundle_label_selector(cluster):
+    """Each bundle individually constrained."""
+    pg = ray_tpu.placement_group(
+        [{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD",
+        bundle_label_selector=[{"zone": "z1"}, {"zone": "z2"}])
+    assert pg.ready(timeout=30)
+    info = _pg_info(pg)
+    zone_of = {n["node_id"]: n["labels"].get("zone")
+               for n in ray_tpu.nodes()}
+    zones = [zone_of[nid] for nid in info["bundle_nodes"]]
+    assert zones == ["z1", "z2"], zones
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_pg_slice_atomic_reservation(cluster):
+    """$same gang: both bundles land on ONE slice; the mismatched slice-b
+    node is never mixed in (reference: tpu.py:145 reserve_tpu_slice)."""
+    pg = ray_tpu.placement_group(
+        [{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD",
+        bundle_label_selector=[{SLICE: "$same"}, {SLICE: "$same"}])
+    assert pg.ready(timeout=30)
+    info = _pg_info(pg)
+    slice_of = {n["node_id"]: n["labels"].get(SLICE)
+                for n in ray_tpu.nodes()}
+    slices = {slice_of[nid] for nid in info["bundle_nodes"]}
+    # Both bundles on one slice — necessarily slice-a (slice-b has 1 node
+    # and STRICT_SPREAD needs 2 distinct nodes).
+    assert slices == {"slice-a"}, slices
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_pg_slice_reservation_infeasible_stays_pending(cluster):
+    """3 gang bundles cannot fit any single slice (max 2 nodes/slice):
+    the PG must stay PENDING — never partially placed across slices."""
+    pg = ray_tpu.placement_group(
+        [{"CPU": 1}] * 3, strategy="STRICT_SPREAD",
+        bundle_label_selector=[{SLICE: "$same"}] * 3)
+    assert not pg.ready(timeout=3)
+    info = _pg_info(pg)
+    assert all(n is None for n in info["bundle_nodes"])
+    ray_tpu.remove_placement_group(pg)
