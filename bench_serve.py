@@ -1,9 +1,12 @@
-"""Serve benchmark: p50/p95 TTFT + decode throughput for a JAX Llama
-replica behind the HTTP proxy.
+"""Serve benchmark: p50/p95 TTFT + decode throughput for the LLM app
+(continuous-batching engine) behind the HTTP proxy, plus a concurrency
+sweep showing aggregate tokens/s scaling with in-flight streams.
 
 The reference ships no TTFT baseline (BASELINE.json published: {}); this
 produces the framework's own numbers (driver metadata north star: Serve
-p50 TTFT through controller -> proxy -> pow-2 router -> replica actor).
+p50 TTFT through controller -> proxy -> pow-2 router -> replica actor;
+continuous-batching parity target: aggregate tokens/s scaling like
+vLLM's batcher, reference: llm/_internal/serve/.../vllm_models.py:170).
 
 Run: python bench_serve.py [--quick]
 Prints one JSON line per metric.
@@ -13,6 +16,7 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 import urllib.request
 
@@ -24,100 +28,39 @@ def emit(metric: str, value: float, unit: str) -> None:
                       "unit": unit}), flush=True)
 
 
-class LlamaServe:
-    """Greedy decode as a streaming deployment. Fixed-shape forward per
-    step (one XLA compile); a paged-KV Pallas cache is the planned fast
-    path — this measures the serving stack, not peak decode speed."""
-
-    def __init__(self, d_model=1024, n_layers=8, seq=256):
-        import jax
-        import jax.numpy as jnp
-        import numpy as np
-
-        from ray_tpu.models.llama import LlamaConfig, forward, init_params
-
-        self.cfg = LlamaConfig(
-            vocab_size=32000, d_model=d_model, n_layers=n_layers,
-            n_heads=d_model // 128, n_kv_heads=max(1, d_model // 256),
-            d_ff=int(d_model * 2.75), max_seq=seq)
-        self.seq = seq
-        params = init_params(self.cfg, jax.random.PRNGKey(0))
-        self.params = jax.device_put(params)
-
-        cfg = self.cfg
-
-        # Decode N tokens per DEVICE call (lax.fori_loop) and sync once per
-        # chunk: every host<->device sync pays the full link round trip
-        # (~100ms over the axon tunnel; real TPU hosts ~us, but the shape
-        # is right either way — serving stacks stream chunks, not
-        # one-sync-per-token).
-        def decode_chunk(params, buf, pos, n):
-            def body(_, carry):
-                buf, pos = carry
-                logits = forward(params, buf, cfg, None)
-                nxt = jnp.argmax(logits[0, pos]).astype(jnp.int32)
-                buf = jax.lax.dynamic_update_slice(
-                    buf, nxt[None, None], (0, pos + 1))
-                return buf, pos + 1
-
-            return jax.lax.fori_loop(0, n, body, (buf, pos))
-
-        self._decode = jax.jit(decode_chunk, static_argnums=3)
-        # Warm both chunk sizes so TTFT measures serving, not XLA.
-        toks = jnp.zeros((1, seq), jnp.int32)
-        for n in (1, 4):
-            b, p = self._decode(self.params, toks, 8, n)
-        int(p)
-        self._jnp = jnp
-        self._np = np
-
-    def __call__(self, body):
-        jnp = self._jnp
-        prompt = body.get("prompt_len", 16) if isinstance(body, dict) else 16
-        max_new = body.get("max_tokens", 8) if isinstance(body, dict) else 8
-        toks = self._np.zeros((1, self.seq), self._np.int32)
-        toks[0, :prompt] = self._np.arange(1, prompt + 1)
-        buf = jnp.asarray(toks)
-        pos = prompt - 1
-        produced = 0
-        first = True
-        while produced < max_new and pos + 1 < self.seq:
-            n = 1 if first else min(4, max_new - produced)
-            first = False
-            buf, pos2 = self._decode(self.params, buf, pos, n)
-            new = self._np.asarray(buf[0, pos + 1:int(pos2) + 1])  # one sync
-            pos = int(pos2)
-            produced += len(new)
-            for t in new:
-                yield f"{int(t)} "
-
-
 def main() -> None:
     import ray_tpu
     import ray_tpu.serve as serve
+    from ray_tpu.serve.llm import LLMConfig, build_llm_app
     from ray_tpu.utils.config import GlobalConfig
 
     GlobalConfig.initialize({"tpu_chips_per_host": 1})
     ray_tpu.init(resources={"CPU": 8})
     try:
         serve.start(http=True)
-        dep = serve.deployment(num_tpus=1)(LlamaServe)
-        d_model = 512 if QUICK else 1024
-        layers = 4 if QUICK else 8
-        serve.run(dep.bind(d_model, layers), name="llama")
+        cfg = LLMConfig(
+            vocab_size=32000,
+            d_model=512 if QUICK else 1024,
+            n_layers=4 if QUICK else 8,
+            max_seq=256,
+            num_tpus=1,
+            max_ongoing_requests=8,   # KV arena slots
+            decode_chunk=4)
+        serve.run(build_llm_app(cfg), name="llama")
         port = serve.get_proxy().port
         url = f"http://127.0.0.1:{port}/llama"
 
-        def one_request() -> tuple:
+        def one_request(max_tokens: int = 8) -> tuple:
             req = urllib.request.Request(
-                url, data=json.dumps({"prompt_len": 16,
-                                      "max_tokens": 8}).encode(),
+                url, data=json.dumps(
+                    {"prompt": list(range(1, 17)),
+                     "max_tokens": max_tokens}).encode(),
                 headers={"x-serve-stream": "1"})
             t0 = time.perf_counter()
             ttft = None
             n_tok = 0
             body = b""
-            with urllib.request.urlopen(req, timeout=300) as resp:
+            with urllib.request.urlopen(req, timeout=600) as resp:
                 # read(1): http.client's chunked read(n) waits to gather n
                 # bytes ACROSS chunks, which would hide first-chunk timing.
                 while True:
@@ -144,12 +87,54 @@ def main() -> None:
             if total > ttft and n_tok > 1:
                 rates.append((n_tok - 1) / (total - ttft))
         ttfts.sort()
-        emit("serve_llama_ttft_p50", ttfts[len(ttfts) // 2], "ms")
+        solo_p50 = ttfts[len(ttfts) // 2]
+        emit("serve_llama_ttft_p50", solo_p50, "ms")
         emit("serve_llama_ttft_p95",
              ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.95))], "ms")
         if rates:
             emit("serve_llama_decode_tokens_per_s",
                  sum(rates) / len(rates), "tokens/s")
+
+        # ------------------------------------------------------------------
+        # Concurrency sweep: aggregate tokens/s + p50 TTFT per level.
+        # Continuous batching target: >=4x aggregate 1 -> 8 streams, TTFT
+        # p50 within 2x of solo.
+        # ------------------------------------------------------------------
+        max_tokens = 16 if QUICK else 32
+        base_rate = None
+        for conc in (1, 4, 8):
+            results: list = [None] * conc
+            errors: list = []
+
+            def run(i):
+                try:
+                    results[i] = one_request(max_tokens)
+                except Exception as e:  # surfaced below, not swallowed
+                    errors.append((i, repr(e)))
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(conc)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                raise RuntimeError(
+                    f"concurrency level {conc}: {len(errors)} request(s) "
+                    f"failed: {errors}")
+            toks = sum(r[1] for r in results)
+            c_ttfts = sorted(r[0] * 1000 for r in results)
+            agg = toks / wall
+            p50 = c_ttfts[len(c_ttfts) // 2]
+            emit(f"serve_llama_agg_tokens_per_s_c{conc}", agg, "tokens/s")
+            emit(f"serve_llama_ttft_p50_c{conc}", p50, "ms")
+            if conc == 1:
+                base_rate = agg
+            elif conc == 8 and base_rate:
+                emit("serve_llama_batching_speedup_1_to_8",
+                     agg / base_rate, "x")
     finally:
         try:
             serve.shutdown()

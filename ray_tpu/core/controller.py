@@ -624,6 +624,10 @@ class Controller:
                                      bundle_label_selector=None) -> dict:
         # Validate eagerly: an error inside the fire-and-forget scheduler
         # would leave the PG silently PENDING forever.
+        if bundle_label_selector is not None and \
+                len(bundle_label_selector) != len(bundles):
+            raise ValueError("bundle_label_selector must have one entry "
+                             "per bundle")
         gang = {k for sel in (bundle_label_selector or []) if sel
                 for k, v in sel.items() if v == "$same"}
         if len(gang) > 1:

@@ -44,17 +44,19 @@ class LLMConfig:
 
 
 class LLMServer:
-    """The replica: builds the model once (XLA compile in the
-    constructor; serve's startup grace covers it), then serves
-    streaming completions."""
+    """The replica: builds the model + continuous-batching engine once
+    (XLA compile in the constructor; serve's startup grace covers it),
+    then serves streaming completions. Concurrent requests share ONE
+    decode loop over a slotted KV arena (serve/engine.py) — aggregate
+    tokens/s scales with occupancy instead of serializing."""
 
     def __init__(self, cfg_blob: bytes):
         import cloudpickle
         import jax
         import jax.numpy as jnp
-        import numpy as np
 
-        from ray_tpu.models.llama import LlamaConfig, forward, init_params
+        from ray_tpu.models.llama import LlamaConfig, init_params
+        from ray_tpu.serve.engine import Engine
 
         cfg: LLMConfig = cloudpickle.loads(cfg_blob)
         self.cfg = cfg
@@ -69,31 +71,9 @@ class LLMServer:
             params = jax.tree.map(jnp.asarray, _unflatten(host))
         else:
             params = init_params(self.mcfg, jax.random.PRNGKey(0))
-        self.params = jax.device_put(params)
-        mcfg = self.mcfg
-
-        def decode_chunk(params, buf, pos, n):
-            def body(_, carry):
-                buf, pos = carry
-                logits = forward(params, buf, mcfg, None)
-                nxt = jnp.argmax(logits[0, pos]).astype(jnp.int32)
-                buf = jax.lax.dynamic_update_slice(
-                    buf, nxt[None, None], (0, pos + 1))
-                return buf, pos + 1
-
-            return jax.lax.fori_loop(0, n, body, (buf, pos))
-
-        self._decode = jax.jit(decode_chunk, static_argnums=3)
-        toks = jnp.zeros((1, cfg.max_seq), jnp.int32)
-        # Exactly TWO compiled shapes ever run: the 1-token TTFT chunk
-        # and the full decode_chunk (residuals decode the full chunk and
-        # truncate the emission — a residual-sized call would recompile
-        # mid-request).
-        for n in (1, cfg.decode_chunk):
-            b, p = self._decode(self.params, toks, 8, n)
-        int(p)
-        self._np = np
-        self._jnp = jnp
+        self.engine = Engine(jax.device_put(params), self.mcfg,
+                             n_slots=cfg.max_ongoing_requests,
+                             decode_chunk=cfg.decode_chunk)
 
     def _encode(self, prompt) -> List[int]:
         if isinstance(prompt, list):
@@ -110,29 +90,16 @@ class LLMServer:
 
     def __call__(self, body: Dict[str, Any]):
         """Streaming completion: yields decoded chunks (OpenAI-ish
-        request body: {"prompt": [...ids] | str, "max_tokens": N})."""
-        jnp, np = self._jnp, self._np
-        ids = self._encode(body.get("prompt", [1]))[: self.cfg.max_seq - 1]
+        request body: {"prompt": [...ids] | str, "max_tokens": N}).
+        Each concurrent request is a slot of the shared decode loop."""
+        ids = self._encode(body.get("prompt", [1]))
         max_new = int(body.get("max_tokens", 16))
-        toks = np.zeros((1, self.cfg.max_seq), np.int32)
-        toks[0, :len(ids)] = ids
-        buf = jnp.asarray(toks)
-        pos = len(ids) - 1
-        produced = 0
-        first = True
-        # Stop when fewer than a full chunk of positions remain: only the
-        # 1-token and full-chunk shapes are ever compiled.
-        while produced < max_new and (
-                pos + 1 + (0 if first else self.cfg.decode_chunk)
-                <= self.cfg.max_seq):
-            n = 1 if first else self.cfg.decode_chunk
-            first = False
-            buf, pos2 = self._decode(self.params, buf, pos, n)
-            new = [int(t) for t in np.asarray(
-                buf[0, pos + 1:int(pos2) + 1])][:max_new - produced]
-            pos = int(pos2)
-            produced += len(new)
-            out = self._decode_text(new)
+        stream = self.engine.submit(ids, max_new)
+        while True:
+            toks = stream.get()
+            if toks is None:
+                return
+            out = self._decode_text(toks)
             yield (out if isinstance(out, str)
                    else " ".join(str(t) for t in out) + " ")
 
