@@ -212,6 +212,28 @@ class Dataset:
         refs = [r for r in _shuffle.remote(mat._sources, n_blocks, seed)]
         return Dataset(refs, [], name=f"{self._name}(shuffled)")
 
+    def groupby(self, key: str, *,
+                num_partitions: Optional[int] = None):
+        """Group rows by a column via a distributed hash shuffle
+        (reference: dataset.py:2688 groupby -> GroupedData). Aggregations
+        and map_groups run one reducer task per partition."""
+        from ray_tpu.data.shuffle import GroupedData
+        return GroupedData(self, key, num_partitions)
+
+    def join(self, other: "Dataset", on: str, how: str = "inner", *,
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join with another dataset (reference:
+        data/_internal/execution/operators/join.py; inner/left). Both
+        sides co-partition by a process-stable key hash; right-side
+        column collisions get a _right suffix."""
+        from ray_tpu.data.shuffle import join_datasets
+        return join_datasets(self, other, on, how, num_partitions)
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column (reference: dataset.py unique)."""
+        out = self.groupby(column).count().take_all()
+        return [r[column] for r in out]
+
     def union(self, *others: "Dataset") -> "Dataset":
         """Concatenate datasets (reference: dataset.py union). Blocks of
         each input stream in order (materialization-free); transforms
