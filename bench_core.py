@@ -11,6 +11,7 @@ Run: python bench_core.py [--quick]
 
 from __future__ import annotations
 
+import os
 import json
 import sys
 import time
@@ -189,6 +190,9 @@ def bench_n_n_actor_calls():
 
 
 def main() -> None:
+    # Warm worker pool: burst benches measure dispatch, not process
+    # spawning (reference ray_perf also runs against prestarted pools).
+    os.environ.setdefault("RAY_TPU_WORKER_PRESTART", "12")
     ray_tpu.init(resources={"CPU": 16})
     try:
         bench_tasks_sync()

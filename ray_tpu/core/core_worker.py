@@ -27,7 +27,9 @@ import asyncio
 import concurrent.futures
 import hashlib
 import os
+import pickle
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -108,6 +110,10 @@ class CoreWorker:
         self.store_dir: Optional[str] = None
         self.port: int = 0
 
+        # NOTE: no eager task factory anywhere — measured: eager startup
+        # reorders the lease pump's submit/grant interleaving and the
+        # driver client's send/recv pattern badly (up to 20x slower burst
+        # submission on the 1-core host).
         self._loop = asyncio.new_event_loop()
         self._io_thread = threading.Thread(
             target=self._loop.run_forever, daemon=True, name="cw-io")
@@ -137,6 +143,10 @@ class CoreWorker:
         # which is dropped on transient connection errors) so a reconnect to
         # the SAME incarnation never resets the seqno stream.
         self._actor_seq_out: Dict[bytes, int] = {}
+        # Per-actor push coalescing (one in-flight batch RPC per actor).
+        self._actor_push_buf: Dict[bytes, list] = {}
+        self._actor_flushing: set = set()
+        self._actor_task_ms: Dict[bytes, float] = {}  # exec-time EMA
         self._actor_incarnation: Dict[bytes, int] = {}
         # Actor-state pubsub: terminal deaths observed on the controller's
         # actor_events channel (fail-fast without a wait_actor_ready RPC).
@@ -155,6 +165,8 @@ class CoreWorker:
         # receiver acks (ack_reply_refs) or the grace fallback fires.
         self._reply_holds: Dict[Any, list] = {}
         self._reply_hold_timers: Dict[Any, Any] = {}
+        from collections import OrderedDict
+        self._map_cache: "OrderedDict[bytes, Any]" = OrderedDict()
         # Cancellation: task_ids cancelled by the user; where tasks execute.
         self._cancelled: set = set()
         self._task_exec_addr: Dict[bytes, Address] = {}
@@ -181,6 +193,7 @@ class CoreWorker:
         self._class_queues: Dict[tuple, list] = {}
         self._class_pumps: Dict[tuple, asyncio.Task] = {}
         self._class_runners: Dict[tuple, set] = {}
+        self._class_lease_cap: Dict[tuple, int] = {}
         self._class_events: Dict[tuple, asyncio.Event] = {}
         self._next_put_index = 0
 
@@ -206,7 +219,20 @@ class CoreWorker:
             coro.close()
 
     async def _async_init(self) -> None:
-        self.agent = RpcClient(self.agent_addr)
+        # Same-host agent RPC rides a unix socket when one is available
+        # (spawned workers get it via env; the driver probes below).
+        sock = os.environ.get("RAY_TPU_AGENT_SOCK", "")
+        if sock and os.path.exists(sock):
+            self.agent = RpcClient(sock)
+        else:
+            self.agent = RpcClient(self.agent_addr)
+            try:
+                sock = await self.agent.call("sock_path")
+                if sock and os.path.exists(sock):
+                    await self.agent.close()  # drop the TCP probe conn
+                    self.agent = RpcClient(sock)
+            except Exception:
+                pass  # older agent or cross-host: stay on TCP
         self.controller = RpcClient(self.controller_addr)
         server = RpcServer("core_worker")
         server.register_object(self, prefix="")
@@ -841,31 +867,19 @@ class CoreWorker:
         return ref.owner_addr is None or tuple(ref.owner_addr) == self.address
 
     async def _store_put(self, oid: bytes, sv) -> None:
+        meta = sv.meta()
+        total = sv.total_size + len(meta)
         path = await self.agent.call("store_create", oid, sv.total_size,
-                                     len(sv.meta()))
-        total = sv.total_size + len(sv.meta())
-        import mmap as mmap_mod
+                                     len(meta))
 
         def _write():
-            # Pre-fault the tmpfs pages (fallocate + MAP_POPULATE): cold
-            # per-page faults during the copy run ~10x slower than a
-            # kernel-side prefault on this class of VM (measured 0.13 vs
-            # 1.4+ GiB/s for a 1 GiB put).
+            # pwrite, not mmap+populate: kernel-side bulk copies run ~2x
+            # faster than the per-page fault+PTE path on this VM class
+            # (3.1 vs 1.6 GiB/s raw for a 1 GiB tmpfs write).
             fd = os.open(path, os.O_RDWR)
             try:
-                fallocate = getattr(os, "posix_fallocate", None)
-                if fallocate is not None:
-                    try:
-                        fallocate(fd, 0, total)
-                    except OSError:
-                        pass
-                flags = mmap_mod.MAP_SHARED | getattr(
-                    mmap_mod, "MAP_POPULATE", 0)
-                with mmap_mod.mmap(fd, total, flags=flags) as m:
-                    mv = memoryview(m)
-                    sv.write_into(mv[:sv.total_size])
-                    mv[sv.total_size:] = sv.meta()
-                    mv.release()
+                sv.write_to_fd(fd)
+                os.pwrite(fd, meta, sv.total_size)
             finally:
                 os.close(fd)
 
@@ -962,13 +976,28 @@ class CoreWorker:
                              ObjectID(oid), addr, e)
         return await self.agent.call("store_contains", oid) == 1
 
+    # Mapping cache: repeat gets of a sealed object skip the store RPC and
+    # re-mapping entirely (sealed objects are immutable; ObjectIDs are
+    # never reused, so a cached mapping can only ever serve live data —
+    # tmpfs pages stay valid until munmap even after an unlink).
+    _MAP_CACHE_MAX = 32
+    _MAP_CACHE_ENTRY_MAX = 16 * 1024 * 1024
+
     async def _map_local(self, oid: bytes) -> Any:
+        mo = self._map_cache.get(oid)
+        if mo is not None:
+            self._map_cache.move_to_end(oid)
+            return serialization.deserialize(mo.data, bytes(mo.meta))
         got = await self.agent.call("store_get", oid)
         if got is None:
             raise ObjectLostError(f"object {ObjectID(oid)} vanished locally")
         path, ds, ms = got
         try:
             mo = MappedObject(path, ds, ms)
+            if ds + ms <= self._MAP_CACHE_ENTRY_MAX:
+                self._map_cache[oid] = mo
+                while len(self._map_cache) > self._MAP_CACHE_MAX:
+                    self._map_cache.popitem(last=False)
             # Deserialized arrays keep views into the mapping alive; the pin
             # can be dropped immediately (tmpfs pages live until munmap).
             return serialization.deserialize(mo.data, bytes(mo.meta))
@@ -1204,7 +1233,13 @@ class CoreWorker:
             max_leases = GlobalConfig.max_pending_lease_requests_per_class
             fail_streak = 0
             while q:
-                want = max(1, min(max_leases, len(q))) - len(runners)
+                # Adaptive wave size (AIMD-ish): a denial means the node
+                # is saturated at the current concurrency — over-asking
+                # parks requests server-side AND spawns surplus workers
+                # when those parks are granted after the burst already
+                # drained (measured 3x burst slowdown from the churn).
+                cap = self._class_lease_cap.get(key, 4)
+                want = max(1, min(cap, len(q))) - len(runners)
                 if want <= 0:
                     # Enough leased workers for the backlog; sleep until a
                     # runner finishes or a new task arrives (no polling).
@@ -1215,23 +1250,42 @@ class CoreWorker:
                         pass
                     continue
                 spec0 = q[0][0]
-                results = await asyncio.gather(
-                    *[self.agent.call(
+
+                async def _request_one():
+                    # Start the runner THE MOMENT a grant lands: siblings
+                    # of this wave park server-side for the queue-wait
+                    # budget, and a gather-then-start would leave granted
+                    # workers idle exactly that long (measured 10x burst
+                    # slowdown when a wave mixes grants and parks).
+                    r = await self.agent.call(
                         "request_lease", spec0.resources,
                         spec0.placement_group, spec0.pg_bundle_index,
                         spec0.scheduling_strategy, spec0.label_selector)
-                      for _ in range(want)],
+                    if r.get("granted"):
+                        runner = asyncio.ensure_future(
+                            self._lease_runner(key, r))
+                        runners.add(runner)
+                        runner.add_done_callback(
+                            lambda t, _r=runners, _e=ev: (_r.discard(t),
+                                                          _e.set()))
+                    return r
+
+                results = await asyncio.gather(
+                    *[_request_one() for _ in range(want)],
                     return_exceptions=True)
-                granted = [r for r in results
-                           if isinstance(r, dict) and r.get("granted")]
                 errors = [r for r in results if isinstance(r, BaseException)]
-                for lease in granted:
-                    runner = asyncio.ensure_future(
-                        self._lease_runner(key, lease))
-                    runners.add(runner)
-                    runner.add_done_callback(
-                        lambda t, _r=runners, _e=ev: (_r.discard(t),
-                                                      _e.set()))
+                granted_n = sum(1 for r in results if isinstance(r, dict)
+                                and r.get("granted"))
+                denied_n = sum(1 for r in results if isinstance(r, dict)
+                               and not r.get("granted"))
+                if denied_n:
+                    self._class_lease_cap[key] = max(
+                        1, len(runners))
+                elif granted_n == want and q:
+                    # Gentle growth: +1 per fully-granted wave with
+                    # backlog left (aggressive doubling overshoots into
+                    # park-then-surplus-worker churn on small nodes).
+                    self._class_lease_cap[key] = min(max_leases, cap + 1)
                 if errors and len(errors) == len(results):
                     # Agent unreachable: don't hang callers forever — after
                     # a sustained streak, fail everything still queued so
@@ -1299,7 +1353,8 @@ class CoreWorker:
         the reply), False when the worker is suspect."""
         self._task_exec_addr[spec.task_id] = tuple(client._address)
         try:
-            reply = await client.call("push_task", cloudpickle.dumps(spec))
+            reply = await client.call("push_task",
+                                      pickle.dumps(spec, protocol=5))
             self._process_task_reply(spec, reply, client)
             self._release_arg_refs(spec)
             if not fut.done():
@@ -1607,37 +1662,113 @@ class CoreWorker:
         self._actor_clients[actor_id] = (addr, client, incarnation)
         return client
 
+    # Max actor tasks coalesced into one push_task_batch RPC. Batching
+    # amortizes the per-RPC cost (framing, dedup, task spawn, reply hop)
+    # across a burst of submissions to the same actor — the reference's
+    # submit path pipelines through gRPC streams for the same reason
+    # (normal_task_submitter.cc backlog pipelining).
+    _ACTOR_PUSH_BATCH = 64
+
     async def _submit_actor_with_retries(self, spec: TaskSpec) -> None:
+        """Join the per-actor push batch; the flusher coalesces every
+        submission buffered while the previous RPC was in flight."""
+        fut = asyncio.get_running_loop().create_future()
+        buf = self._actor_push_buf.setdefault(spec.actor_id, [])
+        buf.append((spec, fut))
+        if spec.actor_id not in self._actor_flushing:
+            self._actor_flushing.add(spec.actor_id)
+            spawn(self._flush_actor_pushes(spec.actor_id))
+        await fut
+
+    async def _flush_actor_pushes(self, actor_id: bytes) -> None:
+        buf = self._actor_push_buf.setdefault(actor_id, [])
+        try:
+            while buf:
+                # Slow methods don't coalesce: a batch reply lands only
+                # after every member executed, so batching multi-ms tasks
+                # would delay early results for no dispatch win.
+                cap = self._ACTOR_PUSH_BATCH
+                if self._actor_task_ms.get(actor_id, 0.0) > 10.0:
+                    cap = 1
+                # One retry budget per batch: never coalesce tasks with
+                # different max_retries (a retried batch would re-push a
+                # 0-retry neighbor; see the retry loop below).
+                n = 1
+                while (n < cap and n < len(buf)
+                       and buf[n][0].max_retries == buf[0][0].max_retries):
+                    n += 1
+                batch = buf[:n]
+                del buf[:n]
+                try:
+                    await self._push_actor_batch(actor_id, batch)
+                except BaseException as e:
+                    # The flusher must survive (and settle) every batch:
+                    # a raise here would strand all buffered futures.
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(
+                                e if isinstance(e, Exception)
+                                else WorkerCrashedError(repr(e)))
+        finally:
+            # No awaits between the loop's empty check and this discard
+            # (same loop thread), so a submission racing the exit always
+            # sees the flag cleared and spawns a fresh flusher.
+            self._actor_flushing.discard(actor_id)
+
+    async def _push_actor_batch(self, actor_id: bytes, batch: list) -> None:
         from ray_tpu.core.common import ActorDiedError, TaskCancelledError
-        attempts = spec.max_retries + 1
+        live = []
+        for spec, fut in batch:
+            if spec.task_id in self._cancelled and not fut.done():
+                fut.set_exception(
+                    TaskCancelledError(f"task {spec.name} cancelled"))
+            else:
+                live.append((spec, fut))
+        if not live:
+            return
+        attempts = live[0][0].max_retries + 1
         last: Optional[BaseException] = None
         for attempt in range(attempts):
-            if spec.task_id in self._cancelled:
-                raise TaskCancelledError(f"task {spec.name} cancelled")
             try:
-                client = await self._actor_client(spec.actor_id,
+                client = await self._actor_client(actor_id,
                                                   refresh=attempt > 0)
-                # Assign the per-incarnation send seqno at push time.
-                spec.seqno = self._actor_seq_out.get(spec.actor_id, 0)
-                self._actor_seq_out[spec.actor_id] = spec.seqno + 1
-                self._task_exec_addr[spec.task_id] = tuple(client._address)
+                # Assign per-incarnation send seqnos at push time.
+                blobs = []
+                for spec, _ in live:
+                    spec.seqno = self._actor_seq_out.get(actor_id, 0)
+                    self._actor_seq_out[actor_id] = spec.seqno + 1
+                    self._task_exec_addr[spec.task_id] = \
+                        tuple(client._address)
+                    blobs.append(pickle.dumps(spec, protocol=5))
+                t0 = time.monotonic()
                 try:
-                    reply = await client.call("push_task",
-                                              cloudpickle.dumps(spec))
+                    replies = await client.call("push_task_batch", blobs)
                 finally:
-                    self._task_exec_addr.pop(spec.task_id, None)
-                self._process_task_reply(spec, reply, client)
-                self._release_arg_refs(spec)
+                    for spec, _ in live:
+                        self._task_exec_addr.pop(spec.task_id, None)
+                # EMA of per-task wall time steers the coalescing cap.
+                per_task_ms = (time.monotonic() - t0) * 1000 / len(live)
+                prev = self._actor_task_ms.get(actor_id, per_task_ms)
+                self._actor_task_ms[actor_id] = \
+                    0.7 * prev + 0.3 * per_task_ms
+                for (spec, fut), reply in zip(live, replies):
+                    self._process_task_reply(spec, reply, client)
+                    self._release_arg_refs(spec)
+                    if not fut.done():
+                        fut.set_result(None)
                 return
             except (RpcConnectionLost, ConnectionError, OSError) as e:
                 last = e
                 # Invalidate the cached client so the next submit (this retry
                 # or a future task) re-resolves the actor's current address.
-                self._actor_clients.pop(spec.actor_id, None)
+                self._actor_clients.pop(actor_id, None)
                 await asyncio.sleep(GlobalConfig.task_retry_delay_ms / 1000)
-        raise ActorDiedError(
-            f"actor task {spec.name} failed after {attempts} attempts "
-            f"({last!r})")
+        err = ActorDiedError(
+            f"actor task batch ({len(live)} tasks) failed after "
+            f"{attempts} attempts ({last!r})")
+        for _, fut in live:
+            if not fut.done():
+                fut.set_exception(err)
 
     # ------------------------------------------------------------------
     # task execution (worker side)
@@ -1700,8 +1831,137 @@ class CoreWorker:
         return False  # queued/unknown: the exec-entry flag check handles it
 
     @long_poll
+    async def push_task_batch(self, blobs: list) -> list:
+        """Coalesced actor pushes: ordering still rides each task's seqno
+        (gather keeps async-actor concurrency; sync actors serialize in
+        the exec pool regardless). Consecutive PLAIN sync tasks (actor
+        method, no kwargs-side refs pending, not streaming, in seqno
+        order, no builtin dispatch) additionally execute in ONE exec-pool
+        hop — two thread switches per batch instead of per task."""
+        specs = [pickle.loads(b) for b in blobs]
+        if (self._is_actor_worker
+                and not getattr(self, "_actor_is_async", False)
+                and self._batch_fast_eligible(specs)):
+            return await self._push_batch_fast(specs)
+        return list(await asyncio.gather(
+            *[self._push_task_spec(s) for s in specs]))
+
+    def _batch_fast_eligible(self, specs: list) -> bool:
+        caller = specs[0].caller_id
+        seq = specs[0].seqno
+        for s in specs:
+            if (not s.is_actor_task or s.streaming
+                    or s.method_name.startswith("__rt_dag")
+                    or s.caller_id != caller or s.seqno != seq
+                    or s.num_returns != 1):
+                return False
+            seq += 1
+        return True
+
+    def _error_reply(self, err: BaseException, tb: str = "") -> dict:
+        from ray_tpu.core.common import TaskCancelledError
+        if not isinstance(err, TaskCancelledError):
+            err = TaskError(repr(err), tb)
+        sv = serialization.serialize_error(err)
+        return {"error": sv.to_bytes(), "error_meta": sv.meta()}
+
+    async def _serialize_return(self, task_id: bytes, index: int,
+                                value: Any) -> tuple:
+        """One return value -> wire tuple (shared by _execute and the
+        batch fast path: inline-vs-stored choice + forwarded-ref holds
+        must never diverge between the two)."""
+        sv = serialization.serialize(value)
+        ref_descs = _ref_descs(sv)
+        await self._hold_reply_refs(task_id, sv.contained_refs)
+        if sv.total_size <= GlobalConfig.max_direct_call_object_size:
+            return ("inline", sv.to_bytes(), sv.meta(), ref_descs)
+        oid = ObjectID.for_task_return(TaskID(task_id), index)
+        await self._store_put(oid.binary(), sv)
+        return ("stored", self.node_id, self.agent_addr, sv.total_size,
+                ref_descs)
+
+    async def _push_batch_fast(self, specs: list) -> list:
+        import inspect as _inspect
+
+        first = specs[0]
+        # Per-caller ordering gate, once for the whole contiguous run.
+        if first.seqno != self._actor_seqno.get(first.caller_id, 0):
+            ev = asyncio.Event()
+            self._actor_waiters.setdefault(
+                first.caller_id, {})[first.seqno] = ev
+            await ev.wait()
+        try:
+            resolved = []   # ("ok", spec, method, args, kwargs) |
+            #                 ("err", spec, exception, traceback)
+            fallback = False
+            for s in specs:
+                try:
+                    args, kwargs = await self._resolve_args(s.args)
+                    method = getattr(self._actor_instance, s.method_name)
+                except BaseException as e:
+                    # Per-task isolation: a lost arg or bad method name
+                    # fails ITS task, not the 63 coalesced neighbors.
+                    resolved.append(("err", s, e, traceback.format_exc()))
+                    continue
+                if _inspect.iscoroutinefunction(method):
+                    fallback = True  # mixed sync/async class
+                    break
+                resolved.append(("ok", s, method, args, kwargs))
+            if fallback:
+                # Per-task path (gate already passed for the first seqno;
+                # push_task re-checks and proceeds).
+                return list(await asyncio.gather(
+                    *[self._push_task_spec(s) for s in specs]))
+
+            def run_all():
+                from ray_tpu.core.common import TaskCancelledError
+                out = []
+                tid = threading.get_ident()
+                for item in resolved:
+                    if item[0] == "err":
+                        out.append((False, item[2], item[3]))
+                        continue
+                    _, s, method, args, kwargs = item
+                    if s.task_id in self._exec_cancelled:
+                        self._exec_cancelled.discard(s.task_id)
+                        out.append((False, TaskCancelledError(
+                            f"task {s.name} cancelled"), ""))
+                        continue
+                    # Register for cancel interruption, like _execute.
+                    self._exec_threads[s.task_id] = tid
+                    try:
+                        out.append((True, method(*args, **kwargs), ""))
+                    except BaseException as e:  # per-task error reply
+                        out.append((False, e, traceback.format_exc()))
+                    finally:
+                        self._exec_threads.pop(s.task_id, None)
+                return out
+
+            results = await asyncio.get_running_loop().run_in_executor(
+                self._exec_pool, run_all)
+            replies = []
+            for item, (ok, value, tb) in zip(resolved, results):
+                s = item[1]
+                self._exec_cancelled.discard(s.task_id)
+                if not ok:
+                    replies.append(self._error_reply(value, tb))
+                    continue
+                ret = await self._serialize_return(s.task_id, 0, value)
+                replies.append({"error": None, "returns": [ret]})
+            return replies
+        finally:
+            last = specs[-1]
+            self._actor_seqno[first.caller_id] = last.seqno + 1
+            waiters = self._actor_waiters.get(first.caller_id)
+            if waiters:
+                nxt = waiters.pop(last.seqno + 1, None)
+                if nxt is not None:
+                    nxt.set()
+
     async def push_task(self, spec_blob: bytes) -> dict:
-        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        return await self._push_task_spec(pickle.loads(spec_blob))
+
+    async def _push_task_spec(self, spec: TaskSpec) -> dict:
         if spec.is_actor_task and getattr(self, "_actor_is_async", False):
             # Async actors execute unordered + concurrently (reference:
             # async actor semantics — ordering is explicitly dropped).
@@ -1821,19 +2081,8 @@ class CoreWorker:
             self._exec_cancelled.discard(spec.task_id)
 
         results = (result,) if spec.num_returns == 1 else tuple(result)
-        returns = []
-        for i, value in enumerate(results):
-            sv = serialization.serialize(value)
-            ref_descs = _ref_descs(sv)
-            await self._hold_reply_refs(spec.task_id, sv.contained_refs)
-            oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
-            if sv.total_size <= GlobalConfig.max_direct_call_object_size:
-                returns.append(("inline", sv.to_bytes(), sv.meta(),
-                                ref_descs))
-            else:
-                await self._store_put(oid.binary(), sv)
-                returns.append(("stored", self.node_id, self.agent_addr,
-                                sv.total_size, ref_descs))
+        returns = [await self._serialize_return(spec.task_id, i, value)
+                   for i, value in enumerate(results)]
         return {"error": None, "returns": returns}
 
     async def _hold_reply_refs(self, key, contained_refs) -> None:

@@ -61,6 +61,16 @@ def _hash_partition_codes(vals: np.ndarray, num_partitions: int
 
 
 @ray_tpu.remote
+def _block_columns(block: Any) -> List[str]:
+    """Column names of a block ([] when empty) — schema without moving
+    the data to the driver."""
+    acc = BlockAccessor(block)
+    if not acc.num_rows():
+        return []
+    return list(acc.to_numpy_batch().keys())
+
+
+@ray_tpu.remote
 def _partition_block(block: Any, key: str, num_partitions: int):
     """Map side: split one block into per-partition column blocks."""
     cols = BlockAccessor(block).to_numpy_batch()
@@ -293,10 +303,11 @@ def join_datasets(left, right, on: str, how: str = "inner",
     rparts = _partition_refs(right, on, P)
     right_cols: List[str] = []
     if how == "left":
+        # Schema only — a tiny task per block, never the block itself.
         for ref in right._sources:
-            acc = BlockAccessor(ray_tpu.get(ref))
-            if acc.num_rows():
-                right_cols = list(acc.to_numpy_batch().keys())
+            cols = ray_tpu.get(_block_columns.remote(ref))
+            if cols:
+                right_cols = cols
                 break
     refs = [
         _join_reduce.remote(on, how, len(lparts[j]), right_cols,

@@ -74,6 +74,7 @@ _flag("max_pending_lease_requests_per_class", int, 8, "Pipelined lease requests 
 _flag("lease_queue_wait_ms", int, 1000, "Server-side park time for an unsatisfiable lease request before the client must re-request (kills client-side poll loops).")
 _flag("worker_lease_pipeline_depth", int, 16, "Task pushes kept in flight per leased worker (hides RPC latency; execution on the worker stays serial).")
 _flag("worker_pool_max_idle_workers", int, 8, "Idle workers kept warm per node.")
+_flag("worker_prestart", int, 0, "Workers to spawn at agent startup (reference: worker_pool.cc PrestartWorkers) — warm pools make burst workloads spawn-free.")
 _flag("worker_pool_idle_ttl_s", int, 300, "Kill idle workers after this long.")
 
 # --- streaming generators ---
