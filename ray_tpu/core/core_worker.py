@@ -90,6 +90,15 @@ class _StreamState:
         self.released = False
 
 
+def _spec_has_ref_args(spec: "TaskSpec") -> bool:
+    """True if any wire arg is an ObjectRef (kind 'r')."""
+    for a in spec.args:
+        kind = a[1] if a[0] == "p" else a[2]
+        if kind == "r":
+            return True
+    return False
+
+
 def _ref_descs(sv) -> list:
     """Wire descriptors for the ObjectRefs contained in a serialized
     value: what the receiver needs to adopt borrows (adopt/ack
@@ -146,6 +155,7 @@ class CoreWorker:
         # Per-actor push coalescing (one in-flight batch RPC per actor).
         self._actor_push_buf: Dict[bytes, list] = {}
         self._actor_flushing: set = set()
+        self._actor_push_sem: Dict[bytes, asyncio.Semaphore] = {}
         self._actor_task_ms: Dict[bytes, float] = {}  # exec-time EMA
         self._actor_incarnation: Dict[bytes, int] = {}
         # Actor-state pubsub: terminal deaths observed on the controller's
@@ -167,6 +177,7 @@ class CoreWorker:
         self._reply_hold_timers: Dict[Any, Any] = {}
         from collections import OrderedDict
         self._map_cache: "OrderedDict[bytes, Any]" = OrderedDict()
+        self._map_cache_bytes = 0
         # Cancellation: task_ids cancelled by the user; where tasks execute.
         self._cancelled: set = set()
         self._task_exec_addr: Dict[bytes, Address] = {}
@@ -432,6 +443,7 @@ class CoreWorker:
         # ObjectRef protocol).
         self.objects.pop(oid, None)
         self.free_device_object(oid)
+        self._drop_map_cache(oid)
         for node_id, addr in list(e.locations):
             try:
                 peer = self._client_for_worker(tuple(addr))
@@ -979,9 +991,11 @@ class CoreWorker:
     # Mapping cache: repeat gets of a sealed object skip the store RPC and
     # re-mapping entirely (sealed objects are immutable; ObjectIDs are
     # never reused, so a cached mapping can only ever serve live data —
-    # tmpfs pages stay valid until munmap even after an unlink).
-    _MAP_CACHE_MAX = 32
-    _MAP_CACHE_ENTRY_MAX = 16 * 1024 * 1024
+    # tmpfs pages stay valid until munmap even after an unlink). Byte-
+    # bounded: these mappings pin tmpfs pages OUTSIDE the store's
+    # capacity accounting, so the budget stays small.
+    _MAP_CACHE_MAX_BYTES = 32 * 1024 * 1024
+    _MAP_CACHE_ENTRY_MAX = 4 * 1024 * 1024
 
     async def _map_local(self, oid: bytes) -> Any:
         mo = self._map_cache.get(oid)
@@ -996,13 +1010,20 @@ class CoreWorker:
             mo = MappedObject(path, ds, ms)
             if ds + ms <= self._MAP_CACHE_ENTRY_MAX:
                 self._map_cache[oid] = mo
-                while len(self._map_cache) > self._MAP_CACHE_MAX:
-                    self._map_cache.popitem(last=False)
+                self._map_cache_bytes += ds + ms
+                while self._map_cache_bytes > self._MAP_CACHE_MAX_BYTES:
+                    old_oid, old = self._map_cache.popitem(last=False)
+                    self._map_cache_bytes -= len(old.data) + len(old.meta)
             # Deserialized arrays keep views into the mapping alive; the pin
             # can be dropped immediately (tmpfs pages live until munmap).
             return serialization.deserialize(mo.data, bytes(mo.meta))
         finally:
             await self.agent.call("store_release", oid)
+
+    def _drop_map_cache(self, oid: bytes) -> None:
+        mo = self._map_cache.pop(oid, None)
+        if mo is not None:
+            self._map_cache_bytes -= len(mo.data) + len(mo.meta)
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[list, list]:
@@ -1680,8 +1701,19 @@ class CoreWorker:
             spawn(self._flush_actor_pushes(spec.actor_id))
         await fut
 
+    # In-flight batch RPCs per actor. Multiple must be allowed: an async
+    # actor method may PARK awaiting a later call (signal patterns) — a
+    # single-in-flight flusher would deadlock it. Seqno ordering across
+    # concurrent batches is preserved by assignment order here plus the
+    # worker's per-caller ordering gate.
+    _ACTOR_PUSH_INFLIGHT = 32
+
     async def _flush_actor_pushes(self, actor_id: bytes) -> None:
         buf = self._actor_push_buf.setdefault(actor_id, [])
+        sem = self._actor_push_sem.get(actor_id)
+        if sem is None:
+            sem = self._actor_push_sem[actor_id] = asyncio.Semaphore(
+                self._ACTOR_PUSH_INFLIGHT)
         try:
             while buf:
                 # Slow methods don't coalesce: a batch reply lands only
@@ -1690,49 +1722,94 @@ class CoreWorker:
                 cap = self._ACTOR_PUSH_BATCH
                 if self._actor_task_ms.get(actor_id, 0.0) > 10.0:
                     cap = 1
-                # One retry budget per batch: never coalesce tasks with
-                # different max_retries (a retried batch would re-push a
-                # 0-retry neighbor; see the retry loop below).
+                # Tasks with OBJECT-REF args always ship alone: a
+                # coalesced dependent whose upstream's reply rides the
+                # same RPC could never resolve its argument (the owner
+                # marks the upstream ready only when the batch returns).
+                # And one retry budget per batch: never coalesce tasks
+                # with different max_retries.
                 n = 1
-                while (n < cap and n < len(buf)
-                       and buf[n][0].max_retries == buf[0][0].max_retries):
-                    n += 1
+                if not _spec_has_ref_args(buf[0][0]):
+                    while (n < cap and n < len(buf)
+                           and not _spec_has_ref_args(buf[n][0])
+                           and buf[n][0].max_retries
+                           == buf[0][0].max_retries):
+                        n += 1
                 batch = buf[:n]
                 del buf[:n]
+                await sem.acquire()
                 try:
-                    await self._push_actor_batch(actor_id, batch)
+                    # Prepare IN flusher order (seqnos must follow the
+                    # submission order even with concurrent sends).
+                    prepared = await self._prepare_actor_batch(actor_id,
+                                                               batch)
                 except BaseException as e:
-                    # The flusher must survive (and settle) every batch:
-                    # a raise here would strand all buffered futures.
+                    sem.release()
                     for _, fut in batch:
                         if not fut.done():
                             fut.set_exception(
                                 e if isinstance(e, Exception)
                                 else WorkerCrashedError(repr(e)))
+                    continue
+                if prepared is None:
+                    sem.release()
+                    continue
+                task = spawn(self._send_actor_batch(actor_id, *prepared))
+                task.add_done_callback(lambda _t, _s=sem: _s.release())
         finally:
             # No awaits between the loop's empty check and this discard
             # (same loop thread), so a submission racing the exit always
             # sees the flag cleared and spawns a fresh flusher.
             self._actor_flushing.discard(actor_id)
 
-    async def _push_actor_batch(self, actor_id: bytes, batch: list) -> None:
-        from ray_tpu.core.common import ActorDiedError, TaskCancelledError
+    async def _prepare_actor_batch(self, actor_id: bytes, batch: list):
+        """Resolve the client + assign seqnos + pickle, in order.
+        Returns (client, live, blobs) or None if nothing left."""
+        from ray_tpu.core.common import TaskCancelledError
         live = []
         for spec, fut in batch:
             if spec.task_id in self._cancelled and not fut.done():
                 fut.set_exception(
                     TaskCancelledError(f"task {spec.name} cancelled"))
-            else:
+            elif not fut.done():
                 live.append((spec, fut))
         if not live:
-            return
+            return None
+        client = await self._actor_client(actor_id)
+        blobs = []
+        for spec, _ in live:
+            spec.seqno = self._actor_seq_out.get(actor_id, 0)
+            self._actor_seq_out[actor_id] = spec.seqno + 1
+            self._task_exec_addr[spec.task_id] = tuple(client._address)
+            blobs.append(pickle.dumps(spec, protocol=5))
+        return client, live, blobs
+
+    async def _send_actor_batch(self, actor_id: bytes, client, live: list,
+                                blobs: list) -> None:
+        from ray_tpu.core.common import ActorDiedError, TaskCancelledError
         attempts = live[0][0].max_retries + 1
         last: Optional[BaseException] = None
         for attempt in range(attempts):
-            try:
-                client = await self._actor_client(actor_id,
-                                                  refresh=attempt > 0)
-                # Assign per-incarnation send seqnos at push time.
+            if attempt > 0:
+                # Cancellation can land while the actor is unreachable:
+                # drop cancelled members before re-pushing.
+                still = []
+                for spec, fut in live:
+                    if spec.task_id in self._cancelled and not fut.done():
+                        fut.set_exception(TaskCancelledError(
+                            f"task {spec.name} cancelled"))
+                    elif not fut.done():
+                        still.append((spec, fut))
+                live = still
+                if not live:
+                    return
+                try:
+                    client = await self._actor_client(actor_id,
+                                                      refresh=True)
+                except BaseException as e:
+                    last = e if isinstance(e, Exception) else \
+                        WorkerCrashedError(repr(e))
+                    break
                 blobs = []
                 for spec, _ in live:
                     spec.seqno = self._actor_seq_out.get(actor_id, 0)
@@ -1740,7 +1817,8 @@ class CoreWorker:
                     self._task_exec_addr[spec.task_id] = \
                         tuple(client._address)
                     blobs.append(pickle.dumps(spec, protocol=5))
-                t0 = time.monotonic()
+            t0 = time.monotonic()
+            try:
                 try:
                     replies = await client.call("push_task_batch", blobs)
                 finally:
@@ -1763,9 +1841,15 @@ class CoreWorker:
                 # or a future task) re-resolves the actor's current address.
                 self._actor_clients.pop(actor_id, None)
                 await asyncio.sleep(GlobalConfig.task_retry_delay_ms / 1000)
-        err = ActorDiedError(
-            f"actor task batch ({len(live)} tasks) failed after "
-            f"{attempts} attempts ({last!r})")
+            except BaseException as e:
+                last = e if isinstance(e, Exception) else \
+                    WorkerCrashedError(repr(e))
+                break
+        err = last if isinstance(last, Exception) and not isinstance(
+            last, (RpcConnectionLost, ConnectionError, OSError)) else \
+            ActorDiedError(
+                f"actor task batch ({len(live)} tasks) failed after "
+                f"{attempts} attempts ({last!r})")
         for _, fut in live:
             if not fut.done():
                 fut.set_exception(err)

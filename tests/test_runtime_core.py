@@ -272,3 +272,52 @@ def test_actor_method_num_returns(cluster):
     a, b = s.pair.options(num_returns=2).remote(4)
     assert ray_tpu.get(a) == 4
     assert ray_tpu.get(b) == 40
+
+
+def test_dependent_actor_calls_no_batch_deadlock(cluster):
+    """A call whose arg is the ref of the immediately-preceding call to
+    the SAME actor must not coalesce into one RPC with its upstream
+    (the owner can only mark the upstream ready when the batch replies)."""
+    @ray_tpu.remote
+    class Chain:
+        def f(self):
+            return 1
+
+        def g(self, x):
+            return x + 1
+
+    a = Chain.remote()
+    ray_tpu.get(a.f.remote())  # warm
+    r2 = a.g.remote(a.f.remote())
+    assert ray_tpu.get(r2, timeout=30) == 2
+    # Longer dependent chains too.
+    r = a.f.remote()
+    for _ in range(5):
+        r = a.g.remote(r)
+    assert ray_tpu.get(r, timeout=30) == 6
+
+
+def test_async_actor_signal_concurrency(cluster):
+    """A parked async method must not block the push of the call that
+    unblocks it (multiple in-flight pushes per actor)."""
+    import time as _time
+
+    @ray_tpu.remote
+    class Sig:
+        def __init__(self):
+            import asyncio
+            self.ev = asyncio.Event()
+
+        async def wait(self):
+            await self.ev.wait()
+            return "released"
+
+        async def send(self):
+            self.ev.set()
+            return "sent"
+
+    s = Sig.remote()
+    w = s.wait.remote()
+    _time.sleep(0.3)  # let wait() park inside the actor
+    assert ray_tpu.get(s.send.remote(), timeout=15) == "sent"
+    assert ray_tpu.get(w, timeout=15) == "released"
