@@ -283,6 +283,12 @@ class Controller:
     # ------------------------------------------------------------------
     # pubsub
     # ------------------------------------------------------------------
+    async def pubsub_publish(self, channel: str, event: Any) -> None:
+        """Publish an event from anywhere in the cluster (reference: gcs
+        pubsub handles external publishers; serve uses this for router
+        push-invalidation, channel 'serve_events')."""
+        self.pubsub.publish(channel, event)
+
     @long_poll
     async def pubsub_poll(self, channel: str, from_seq: int,
                           timeout: float = 30.0) -> dict:

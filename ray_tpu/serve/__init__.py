@@ -24,9 +24,10 @@ from ray_tpu.serve.api import (Application, Deployment, batch, delete,
                                deployment, get_deployment_handle, get_proxy,
                                run, shutdown, start)
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application", "Deployment", "DeploymentHandle", "DeploymentResponse",
     "batch", "delete", "deployment", "get_deployment_handle", "get_proxy",
-    "run", "shutdown", "start",
+    "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
 ]

@@ -68,7 +68,7 @@ class ServeController:
                     self._drain_and_kill(r)
             self._deployments[name] = _DeploymentState(name, config)
             self._reconcile_one(self._deployments[name])
-            self._version += 1
+            self._bump_version()
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
@@ -76,7 +76,7 @@ class ServeController:
             if st is not None:
                 for r in st.replicas:
                     self._drain_and_kill(r, drain_s=5.0)
-                self._version += 1
+                self._bump_version()
 
     def _drain_and_kill(self, replica, drain_s: float = 30.0) -> None:
         """Best-effort drain: let in-flight requests finish before the
@@ -99,6 +99,20 @@ class ServeController:
                 pass
 
         threading.Thread(target=drain, daemon=True).start()
+
+    def _bump_version(self) -> None:
+        self._version += 1
+        # Push-invalidate routers via the core pubsub hub (reference:
+        # serve long_poll.py:228 LongPollHost — ours rides the runtime's
+        # existing hub instead of a serve-private one).
+        try:
+            from ray_tpu.core.ref import get_core_worker
+            cw = get_core_worker()
+            cw._spawn(cw.controller.call(
+                "pubsub_publish", "serve_events",
+                {"version": self._version}))
+        except Exception:
+            pass
 
     def routing_table(self) -> dict:
         """{deployment: [replica handles]} + version for router caching."""
@@ -133,7 +147,7 @@ class ServeController:
                         ray_tpu.kill(r)
                     except Exception:
                         pass
-            self._version += 1
+            self._bump_version()
 
     # -- reconciliation -------------------------------------------------
     def _make_replica(self, st: _DeploymentState):
@@ -164,7 +178,7 @@ class ServeController:
             self._drain_and_kill(victim)  # don't cut in-flight requests
             changed = True
         if changed:
-            self._version += 1
+            self._bump_version()
 
     def _control_loop(self) -> None:
         """Health checks + autoscaling (runs in the controller actor)."""
@@ -241,7 +255,7 @@ class ServeController:
             st.healthy.pop(aid, None)
         if len(alive) != len(st.replicas):
             st.replicas = alive
-            self._version += 1
+            self._bump_version()
             self._reconcile_one(st)  # replace the dead
 
     def ready_replicas(self, name: str) -> int:

@@ -47,9 +47,15 @@ class DeploymentResponse:
 
 
 class Router:
-    """Pow-2 replica chooser with cached routing table."""
+    """Pow-2 replica chooser with a push-invalidated routing table.
 
-    _TABLE_TTL_S = 2.0
+    The serve controller publishes every version bump on the runtime's
+    pubsub hub (channel "serve_events"); the router subscribes and drops
+    its cache the moment a deploy/scale lands — the TTL below is only a
+    safety net against a lost push (reference:
+    serve/_private/long_poll.py:228 LongPollHost push updates)."""
+
+    _TABLE_TTL_S = 30.0  # fallback only; pushes invalidate immediately
 
     _QLEN_TTL_S = 0.1  # probe cache: bounds probe RPCs to ~20/s per pair
 
@@ -61,6 +67,27 @@ class Router:
         self._checked = 0.0
         self._lock = threading.Lock()
         self._qlen_cache: Dict[bytes, tuple] = {}  # aid -> (qlen, ts)
+        # model_id -> replica actor_id: sticky multiplexing affinity
+        # (reference: serve/multiplex.py routes to replicas holding the
+        # model; ours is client-side stickiness with pow-2 fallback).
+        self._model_affinity: Dict[str, bytes] = {}
+        self._sub = None
+        try:
+            from ray_tpu.core.pubsub import Subscription
+            from ray_tpu.core.ref import get_core_worker
+            cw = get_core_worker()
+
+            def _invalidate(_event):
+                self._checked = 0.0  # next choose re-reads the table
+
+            async def _start():
+                self._sub = Subscription(
+                    cw.controller, "serve_events", _invalidate,
+                    from_latest=True).start()
+
+            cw._spawn(_start())
+        except Exception:
+            pass  # no runtime (unit tests): TTL fallback still works
 
     def _refresh(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -76,14 +103,27 @@ class Router:
                 self._replicas = table["deployments"].get(
                     self._deployment, [])
 
-    def choose_replica(self):
+    def choose_replica(self, model_id: str = ""):
         """Power-of-two-choices over live queue lengths (reference:
-        pow_2_router.py:52 choose_replicas)."""
+        pow_2_router.py:52 choose_replicas); multiplexed requests stick
+        to the replica that last served their model id."""
         self._refresh()
         replicas = self._replicas
         if not replicas:
             raise RuntimeError(
                 f"deployment {self._deployment!r} has no replicas")
+        if model_id:
+            aid = self._model_affinity.get(model_id)
+            if aid is not None:
+                for r in replicas:
+                    if r.actor_id.binary() == aid:
+                        return r
+            chosen = self._choose_pow2(replicas)
+            self._model_affinity[model_id] = chosen.actor_id.binary()
+            return chosen
+        return self._choose_pow2(replicas)
+
+    def _choose_pow2(self, replicas):
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
@@ -109,22 +149,33 @@ class Router:
         return q
 
     def on_replica_error(self) -> None:
+        # Sticky affinity must not outlive a failure: retries have to be
+        # free to fail over to a healthy replica.
+        self._model_affinity.clear()
         self._refresh(force=True)
 
 
 class DeploymentHandle:
     def __init__(self, deployment: str, controller_handle,
-                 method: str = "__call__"):
+                 method: str = "__call__", multiplexed_model_id: str = "",
+                 _router: Optional[Router] = None):
         self._deployment = deployment
         self._controller = controller_handle
         self._method = method
-        self._router = Router(deployment, controller_handle)
+        self._model_id = multiplexed_model_id
+        # A Router owns a live pubsub subscription: options() MUST share
+        # the parent's instead of constructing a throwaway one.
+        self._router = _router or Router(deployment, controller_handle)
 
-    def options(self, *, method_name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self._deployment, self._controller,
-                             method_name)
-        h._router = self._router  # share the routing cache
-        return h
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._deployment, self._controller,
+            method_name if method_name is not None else self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._model_id,
+            _router=self._router)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         blob = cloudpickle.dumps((args, kwargs))
@@ -136,8 +187,9 @@ class DeploymentHandle:
             last: Optional[Exception] = None
             for _ in range(3):
                 try:
-                    replica = self._router.choose_replica()
-                    return replica.handle_request.remote(self._method, blob)
+                    replica = self._router.choose_replica(self._model_id)
+                    return replica.handle_request.remote(
+                        self._method, blob, self._model_id)
                 except Exception as e:
                     last = e
                     self._router.on_replica_error()
@@ -157,8 +209,9 @@ class DeploymentHandle:
         yields values as the replica produces them (reference: Serve
         streaming responses over ObjectRefGenerator)."""
         blob = cloudpickle.dumps((args, kwargs))
-        replica = self._router.choose_replica()
+        replica = self._router.choose_replica(self._model_id)
         gen = replica.handle_request_streaming.options(
-            num_returns="streaming").remote(self._method, blob)
+            num_returns="streaming").remote(self._method, blob,
+                                            self._model_id)
         for ref in gen:
             yield ray_tpu.get(ref)

@@ -33,33 +33,47 @@ class Replica:
         self._sem = asyncio.Semaphore(max_ongoing)
         self._started = time.time()
 
-    async def handle_request(self, method: str, args_blob: bytes):
+    async def handle_request(self, method: str, args_blob: bytes,
+                             model_id: str = ""):
         """Run one request through the user callable (async-concurrent).
         Sync callables go to a thread pool — running them on the io loop
         would stall health checks and queue probes, and the controller
         would kill a merely-busy replica."""
+        import contextvars
+
+        from ray_tpu.serve.multiplex import _set_current_model_id
+
         args, kwargs = cloudpickle.loads(args_blob)
         fn = getattr(self._user, method)
         self._ongoing += 1
         self._total += 1
         try:
             async with self._sem:
+                _set_current_model_id(model_id)
                 if inspect.iscoroutinefunction(fn):
                     return await fn(*args, **kwargs)
                 loop = asyncio.get_running_loop()
+                # copy_context: run_in_executor does NOT propagate
+                # contextvars, and get_multiplexed_model_id must work
+                # inside sync callables too.
+                ctx = contextvars.copy_context()
                 return await loop.run_in_executor(
-                    None, lambda: fn(*args, **kwargs))
+                    None, lambda: ctx.run(fn, *args, **kwargs))
         finally:
             self._ongoing -= 1
 
-    def handle_request_streaming(self, method: str, args_blob: bytes):
+    def handle_request_streaming(self, method: str, args_blob: bytes,
+                                 model_id: str = ""):
         """Streaming variant: the user method is a (sync) generator; items
         stream back through the runtime's ObjectRefGenerator."""
+        from ray_tpu.serve.multiplex import _set_current_model_id
+
         args, kwargs = cloudpickle.loads(args_blob)
         fn = getattr(self._user, method)
         self._ongoing += 1
         self._total += 1
         try:
+            _set_current_model_id(model_id)
             yield from fn(*args, **kwargs)
         finally:
             self._ongoing -= 1

@@ -206,3 +206,103 @@ def test_autoscaling_up(cluster):
         stop.set()
         for t in threads:
             t.join(timeout=10)
+
+
+def test_router_pubsub_push_invalidation(cluster):
+    """A redeploy must reach an existing handle's router via pubsub well
+    inside the 30s TTL fallback (reference: long_poll push updates)."""
+    import time as _time
+
+    @serve.deployment(num_replicas=1)
+    class V:
+        def __call__(self, _):
+            return "v1"
+
+    handle = serve.run(V.bind(), name="pushinval")
+    assert handle.remote(None).result(timeout=60) == "v1"
+
+    @serve.deployment(num_replicas=1)
+    class V2:
+        def __call__(self, _):
+            return "v2"
+
+    serve.run(V2.bind(), name="pushinval")
+    deadline = _time.time() + 8.0  # << router TTL (30s): needs the push
+    while _time.time() < deadline:
+        try:
+            if handle.remote(None).result(timeout=30) == "v2":
+                break
+        except Exception:
+            pass
+        _time.sleep(0.2)
+    assert handle.remote(None).result(timeout=30) == "v2"
+    serve.delete("pushinval")
+
+
+def test_model_multiplexing(cluster):
+    """Per-replica LRU model cache + sticky routing + model id context
+    (reference: serve/multiplex.py, serve.multiplexed API)."""
+    import os
+
+    @serve.deployment(num_replicas=2)
+    class Mux:
+        def __init__(self):
+            self.loads = []
+            self._get = serve.multiplexed(
+                max_num_models_per_replica=2)(self._load)
+
+        def _load(self, model_id):
+            self.loads.append(model_id)
+            return {"id": model_id, "pid": os.getpid()}
+
+        def __call__(self, _):
+            model = self._get(serve.get_multiplexed_model_id())
+            return {"model": model["id"], "pid": model["pid"],
+                    "loads": list(self.loads)}
+
+    handle = serve.run(Mux.bind(), name="mux")
+    h_a = handle.options(multiplexed_model_id="model-a")
+    h_b = handle.options(multiplexed_model_id="model-b")
+    first = h_a.remote(None).result(timeout=60)
+    assert first["model"] == "model-a"
+    # Sticky: repeats for the same model hit the same replica and do NOT
+    # reload (loads stays length-1 on that replica).
+    for _ in range(4):
+        again = h_a.remote(None).result(timeout=60)
+        assert again["pid"] == first["pid"]
+        assert again["loads"].count("model-a") == 1
+    outb = h_b.remote(None).result(timeout=60)
+    assert outb["model"] == "model-b"
+    serve.delete("mux")
+
+
+def test_model_multiplexing_lru_eviction(cluster):
+    """One replica, capacity 2: the third model evicts the LRU one, so a
+    re-request of the evicted model reloads it."""
+    @serve.deployment(num_replicas=1)
+    class Mux1:
+        def __init__(self):
+            self.loads = []
+            self._get = serve.multiplexed(
+                max_num_models_per_replica=2)(self._load)
+
+        def _load(self, model_id):
+            self.loads.append(model_id)
+            return model_id
+
+        def __call__(self, _):
+            self._get(serve.get_multiplexed_model_id())
+            return list(self.loads)
+
+    handle = serve.run(Mux1.bind(), name="mux1")
+    for mid in ("a", "b", "c"):  # c evicts a (capacity 2)
+        handle.options(multiplexed_model_id=mid).remote(None).result(
+            timeout=60)
+    loads = handle.options(multiplexed_model_id="a").remote(None).result(
+        timeout=60)
+    assert loads == ["a", "b", "c", "a"], loads  # a was reloaded
+    # b was evicted by a's reload; c is still resident.
+    loads = handle.options(multiplexed_model_id="c").remote(None).result(
+        timeout=60)
+    assert loads == ["a", "b", "c", "a"], loads  # c cached, no reload
+    serve.delete("mux1")
