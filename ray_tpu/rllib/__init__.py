@@ -15,7 +15,11 @@ actors and a jitted JAX learner.
 """
 
 from ray_tpu.rllib.env import CartPole, Env
-from ray_tpu.rllib.learner import PPOLearner
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.learner import IMPALALearner, PPOLearner
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.replay import PrioritizedReplayBuffer, ReplayBuffer
 
-__all__ = ["CartPole", "Env", "PPO", "PPOConfig", "PPOLearner"]
+__all__ = ["CartPole", "Env", "IMPALA", "IMPALAConfig", "IMPALALearner",
+           "PPO", "PPOConfig", "PPOLearner", "PrioritizedReplayBuffer",
+           "ReplayBuffer"]

@@ -110,3 +110,54 @@ class EnvRunner:
             "episode_returns": np.asarray(self._completed_returns,
                                           np.float32),
         }
+
+    def sample_fragment(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """IMPALA-style trajectory fragment: raw transitions + behavior
+        log-probs, NO advantage computation (the learner applies V-trace
+        off-policy correction; reference:
+        rllib/algorithms/impala/impala.py async sample batches)."""
+        obs_buf = np.zeros((num_steps, self._env.observation_size),
+                           np.float32)
+        act_buf = np.zeros(num_steps, np.int32)
+        rew_buf = np.zeros(num_steps, np.float32)
+        term_buf = np.zeros(num_steps, np.float32)
+        trunc_buf = np.zeros(num_steps, np.float32)
+        logp_buf = np.zeros(num_steps, np.float32)
+        # V at TRUNCATION steps must bootstrap from the final pre-reset
+        # obs — same invariant sample() documents for GAE; truncating a
+        # winning episode is not the same as it terminating.
+        trunc_obs = np.zeros((num_steps, self._env.observation_size),
+                             np.float32)
+
+        pi = self._weights["pi"]
+        self._completed_returns = []
+        obs = self._obs
+        for t in range(num_steps):
+            logp = _log_softmax(_np_forward(pi, obs[None, :]))[0]
+            action = int(self._rng.choice(len(logp), p=np.exp(logp)))
+            nxt, rew, term, trunc, _ = self._env.step(action)
+            obs_buf[t] = obs
+            act_buf[t] = action
+            rew_buf[t] = rew
+            logp_buf[t] = logp[action]
+            term_buf[t] = float(term)
+            trunc_buf[t] = float(trunc and not term)
+            if trunc and not term:
+                trunc_obs[t] = nxt
+            self._episode_return += rew
+            if term or trunc:
+                self._completed_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                obs = self._env.reset(
+                    seed=int(self._rng.randint(0, 2 ** 31)))
+            else:
+                obs = nxt
+        self._obs = obs
+        return {
+            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "terms": term_buf, "truncs": trunc_buf,
+            "trunc_obs": trunc_obs, "behavior_logp": logp_buf,
+            "bootstrap_obs": obs.astype(np.float32),
+            "episode_returns": np.asarray(self._completed_returns,
+                                          np.float32),
+        }

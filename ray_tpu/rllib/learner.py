@@ -113,3 +113,106 @@ class PPOLearner:
                 self.params, self._opt_state, aux = self._update(
                     self.params, self._opt_state, mb)
         return {k: float(v) for k, v in aux.items()}
+
+
+class IMPALALearner:
+    """V-trace actor-critic updates on [B, T] trajectory fragments
+    (reference: rllib/algorithms/impala/impala.py:599 training_step +
+    vtrace torch/tf implementations; Espeholt et al. 2018). Off-policy
+    correction lets rollouts be a few updates stale — the async pipeline
+    never waits for the learner."""
+
+    def __init__(self, obs_size: int, num_actions: int, *,
+                 hidden: Tuple[int, ...] = (64, 64), lr: float = 5e-4,
+                 gamma: float = 0.99, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01,
+                 rho_bar: float = 1.0, c_bar: float = 1.0, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        key = jax.random.PRNGKey(seed)
+        k1, k2 = jax.random.split(key)
+        self.params = {
+            "pi": _mlp_init(k1, (obs_size, *hidden, num_actions)),
+            "vf": _mlp_init(k2, (obs_size, *hidden, 1)),
+        }
+        self._opt = optax.adam(lr)
+        self._opt_state = self._opt.init(self.params)
+
+        def loss_fn(params, batch):
+            # batch leaves: obs [B,T,D], actions [B,T], rewards [B,T],
+            # terms/truncs [B,T], trunc_obs [B,T,D],
+            # behavior_logp [B,T], bootstrap_obs [B,D]
+            logits = _mlp_apply(params["pi"], batch["obs"])     # [B,T,A]
+            values = _mlp_apply(params["vf"], batch["obs"])[..., 0]
+            v_boot = _mlp_apply(params["vf"],
+                                batch["bootstrap_obs"])[..., 0]  # [B]
+            v_trunc = _mlp_apply(params["vf"],
+                                 batch["trunc_obs"])[..., 0]    # [B,T]
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            rho = jnp.exp(logp - batch["behavior_logp"])
+            rho_clip = jnp.minimum(rho_bar, rho)
+            c_clip = jnp.minimum(c_bar, rho)
+            terms, truncs = batch["terms"], batch["truncs"]
+            # Termination zeroes the bootstrap; truncation bootstraps
+            # from the final pre-reset obs. BOTH cut the backward carry
+            # (the recursion must not cross episode boundaries).
+            discounts = gamma * (1.0 - terms)                   # [B,T]
+            boundary = jnp.maximum(terms, truncs)
+            v_next = jnp.concatenate(
+                [values[:, 1:], v_boot[:, None]], axis=1)       # [B,T]
+            v_next = jnp.where(truncs > 0, v_trunc, v_next)
+            deltas = rho_clip * (batch["rewards"]
+                                 + discounts * v_next - values)
+
+            # vs_t - V_t recursion, scanned backwards over T.
+            def back(carry, xs):
+                delta_t, carry_disc_t, c_t = xs
+                acc = delta_t + carry_disc_t * c_t * carry
+                return acc, acc
+
+            carry_disc = discounts * (1.0 - boundary)
+            xs = (deltas.T, carry_disc.T, c_clip.T)             # [T,B]
+            _, acc = jax.lax.scan(back, jnp.zeros(values.shape[0]),
+                                  xs, reverse=True)
+            vs = acc.T + values                                 # [B,T]
+            vs_next = jnp.concatenate(
+                [vs[:, 1:], v_boot[:, None]], axis=1)
+            vs_next = jnp.where(truncs > 0, v_trunc, vs_next)
+            pg_adv = rho_clip * (batch["rewards"]
+                                 + discounts * vs_next - values)
+            pi_loss = -jnp.mean(logp * jax.lax.stop_gradient(pg_adv))
+            vf_loss = 0.5 * jnp.mean(
+                (values - jax.lax.stop_gradient(vs)) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._update = update
+
+    def get_weights(self) -> Any:
+        import jax
+        return jax.tree.map(np.asarray, self.params)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """One V-trace update on a stacked [B, T] fragment batch."""
+        import jax.numpy as jnp
+
+        dev = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self._opt_state, aux = self._update(
+            self.params, self._opt_state, dev)
+        return {k: float(v) for k, v in aux.items()}

@@ -46,6 +46,46 @@ class DeploymentResponse:
         return self._ref
 
 
+# ONE pubsub subscription per process invalidates every live router
+# (weakly referenced, so handles still GC); per-router subscriptions
+# would leak a perpetual poll loop per handle.
+_routers: "Any" = None
+_sub_started = False
+
+
+def _register_router(router: "Router") -> None:
+    global _routers, _sub_started
+    import weakref
+
+    if _routers is None:
+        _routers = weakref.WeakSet()
+    _routers.add(router)
+    if _sub_started:
+        return
+    try:
+        from ray_tpu.core.pubsub import Subscription
+        from ray_tpu.core.ref import get_core_worker
+        cw = get_core_worker()
+
+        def _invalidate(_event):
+            for r in list(_routers):
+                r._checked = 0.0  # next choose re-reads the table
+
+        async def _start():
+            Subscription(cw.controller, "serve_events", _invalidate,
+                         from_latest=True).start()
+
+        cw._spawn(_start())
+        _sub_started = True
+    except Exception:
+        # No runtime (unit tests) or init race: the TTL below still
+        # refreshes — but 15x slower than a push, so say so.
+        from ray_tpu.utils import get_logger
+        get_logger("serve").warning(
+            "serve router push-invalidation unavailable; falling back "
+            "to the %ss table TTL", Router._TABLE_TTL_S)
+
+
 class Router:
     """Pow-2 replica chooser with a push-invalidated routing table.
 
@@ -71,23 +111,7 @@ class Router:
         # (reference: serve/multiplex.py routes to replicas holding the
         # model; ours is client-side stickiness with pow-2 fallback).
         self._model_affinity: Dict[str, bytes] = {}
-        self._sub = None
-        try:
-            from ray_tpu.core.pubsub import Subscription
-            from ray_tpu.core.ref import get_core_worker
-            cw = get_core_worker()
-
-            def _invalidate(_event):
-                self._checked = 0.0  # next choose re-reads the table
-
-            async def _start():
-                self._sub = Subscription(
-                    cw.controller, "serve_events", _invalidate,
-                    from_latest=True).start()
-
-            cw._spawn(_start())
-        except Exception:
-            pass  # no runtime (unit tests): TTL fallback still works
+        _register_router(self)
 
     def _refresh(self, force: bool = False) -> None:
         now = time.monotonic()
