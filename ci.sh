@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# CI entry point (reference: the release/CI suites; SURVEY §4 test
+# strategy). Two bounded stages on the 1-core host:
+#   fast  — everything not marked slow; < 5 min wall
+#   slow  — process-spawn / XLA-compile / failure-recovery suites, run
+#           in file chunks so no single pytest invocation exceeds ~8 min
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "=== stage 1: fast suite ==="
+python -m pytest tests/ -m fast -q
+
+echo "=== stage 2: slow suites (chunked) ==="
+python -m pytest tests/test_chaos.py tests/test_oom.py \
+    tests/test_spilling.py tests/test_gcs_ft.py -q
+python -m pytest tests/test_train.py tests/test_checkpointing.py -q
+python -m pytest tests/test_runtime_multinode.py tests/test_data.py \
+    tests/test_device_plane.py -q
+python -m pytest tests/test_serve_llm.py tests/test_tune.py \
+    tests/test_rllib.py -q
+python -m pytest tests/test_ops.py tests/test_model_parallel.py \
+    tests/test_autoscaler.py tests/test_jobs_util.py -q
+
+echo "=== all suites green ==="

@@ -43,79 +43,11 @@ class EnvRunner:
         self._weights = weights
         return True
 
-    def sample(self, num_steps: int, gamma: float = 0.99,
-               gae_lambda: float = 0.95) -> Dict[str, np.ndarray]:
-        """Collect num_steps transitions; returns the PPO batch with GAE
-        advantages computed runner-side (reference: ConnectorV2 GAE)."""
-        obs_buf = np.zeros((num_steps, self._env.observation_size),
-                           np.float32)
-        act_buf = np.zeros(num_steps, np.int32)
-        rew_buf = np.zeros(num_steps, np.float32)
-        done_buf = np.zeros(num_steps, np.float32)
-        logp_buf = np.zeros(num_steps, np.float32)
-        val_buf = np.zeros(num_steps + 1, np.float32)
-
-        pi, vf = self._weights["pi"], self._weights["vf"]
-        self._completed_returns = []
-        obs = self._obs
-        # Bootstrap values at TRUNCATION steps use V(final pre-reset obs)
-        # — using the next episode's reset obs would leak value across
-        # episode boundaries and bias GAE at every truncation.
-        trunc_values: Dict[int, float] = {}
-        for t in range(num_steps):
-            logp = _log_softmax(_np_forward(pi, obs[None, :]))[0]
-            action = int(self._rng.choice(len(logp), p=np.exp(logp)))
-            value = float(_np_forward(vf, obs[None, :])[0, 0])
-            nxt, rew, term, trunc, _ = self._env.step(action)
-            obs_buf[t] = obs
-            act_buf[t] = action
-            rew_buf[t] = rew
-            logp_buf[t] = logp[action]
-            val_buf[t] = value
-            done_buf[t] = float(term)
-            self._episode_return += rew
-            if term or trunc:
-                if trunc and not term:
-                    trunc_values[t] = float(
-                        _np_forward(vf, nxt[None, :])[0, 0])
-                self._completed_returns.append(self._episode_return)
-                self._episode_return = 0.0
-                obs = self._env.reset(
-                    seed=int(self._rng.randint(0, 2 ** 31)))
-            else:
-                obs = nxt
-        self._obs = obs
-        val_buf[num_steps] = float(_np_forward(vf, obs[None, :])[0, 0])
-
-        # GAE(lambda) advantages + returns. The recursion resets across
-        # episode boundaries (term OR trunc); truncation bootstraps.
-        adv = np.zeros(num_steps, np.float32)
-        last = 0.0
-        for t in reversed(range(num_steps)):
-            terminated = done_buf[t] > 0
-            truncated = t in trunc_values
-            if terminated:
-                v_next, nonterminal, carry = 0.0, 0.0, 0.0
-            elif truncated:
-                v_next, nonterminal, carry = trunc_values[t], 1.0, 0.0
-            else:
-                v_next, nonterminal, carry = val_buf[t + 1], 1.0, 1.0
-            delta = rew_buf[t] + gamma * v_next * nonterminal - val_buf[t]
-            last = delta + gamma * gae_lambda * carry * last
-            adv[t] = last
-        returns = adv + val_buf[:num_steps]
-        return {
-            "obs": obs_buf, "actions": act_buf, "logp_old": logp_buf,
-            "advantages": adv, "returns": returns,
-            "episode_returns": np.asarray(self._completed_returns,
-                                          np.float32),
-        }
-
-    def sample_fragment(self, num_steps: int) -> Dict[str, np.ndarray]:
-        """IMPALA-style trajectory fragment: raw transitions + behavior
-        log-probs, NO advantage computation (the learner applies V-trace
-        off-policy correction; reference:
-        rllib/algorithms/impala/impala.py async sample batches)."""
+    def _rollout(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Shared stepping loop: behavior-policy transitions with explicit
+        term/trunc flags and the final pre-reset obs at truncations —
+        using the next episode's reset obs would leak value estimates
+        across episode boundaries (both GAE and V-trace need this)."""
         obs_buf = np.zeros((num_steps, self._env.observation_size),
                            np.float32)
         act_buf = np.zeros(num_steps, np.int32)
@@ -123,9 +55,6 @@ class EnvRunner:
         term_buf = np.zeros(num_steps, np.float32)
         trunc_buf = np.zeros(num_steps, np.float32)
         logp_buf = np.zeros(num_steps, np.float32)
-        # V at TRUNCATION steps must bootstrap from the final pre-reset
-        # obs — same invariant sample() documents for GAE; truncating a
-        # winning episode is not the same as it terminating.
         trunc_obs = np.zeros((num_steps, self._env.observation_size),
                              np.float32)
 
@@ -161,3 +90,47 @@ class EnvRunner:
             "episode_returns": np.asarray(self._completed_returns,
                                           np.float32),
         }
+
+    def sample(self, num_steps: int, gamma: float = 0.99,
+               gae_lambda: float = 0.95) -> Dict[str, np.ndarray]:
+        """Collect num_steps transitions; returns the PPO batch with GAE
+        advantages computed runner-side (reference: ConnectorV2 GAE)."""
+        roll = self._rollout(num_steps)
+        vf = self._weights["vf"]
+        values = _np_forward(vf, roll["obs"])[:, 0]
+        v_boot = float(_np_forward(vf, roll["bootstrap_obs"][None, :])
+                       [0, 0])
+        trunc_vals = _np_forward(vf, roll["trunc_obs"])[:, 0]
+
+        # GAE(lambda) advantages + returns. The recursion resets across
+        # episode boundaries (term OR trunc); truncation bootstraps from
+        # V(final pre-reset obs).
+        adv = np.zeros(num_steps, np.float32)
+        last = 0.0
+        for t in reversed(range(num_steps)):
+            if roll["terms"][t] > 0:
+                v_next, nonterminal, carry = 0.0, 0.0, 0.0
+            elif roll["truncs"][t] > 0:
+                v_next, nonterminal, carry = float(trunc_vals[t]), 1.0, 0.0
+            else:
+                v_next = v_boot if t == num_steps - 1 else \
+                    float(values[t + 1])
+                nonterminal, carry = 1.0, 1.0
+            delta = roll["rewards"][t] + gamma * v_next * nonterminal \
+                - values[t]
+            last = delta + gamma * gae_lambda * carry * last
+            adv[t] = last
+        returns = adv + values
+        return {
+            "obs": roll["obs"], "actions": roll["actions"],
+            "logp_old": roll["behavior_logp"],
+            "advantages": adv, "returns": returns.astype(np.float32),
+            "episode_returns": roll["episode_returns"],
+        }
+
+    def sample_fragment(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """IMPALA-style trajectory fragment: raw transitions + behavior
+        log-probs, NO advantage computation (the learner applies V-trace
+        off-policy correction; reference:
+        rllib/algorithms/impala/impala.py async sample batches)."""
+        return self._rollout(num_steps)

@@ -46,44 +46,54 @@ class DeploymentResponse:
         return self._ref
 
 
-# ONE pubsub subscription per process invalidates every live router
+# ONE pubsub subscription per CORE WORKER invalidates every live router
 # (weakly referenced, so handles still GC); per-router subscriptions
-# would leak a perpetual poll loop per handle.
+# would leak a perpetual poll loop per handle. Keyed by the worker, not
+# a process-lifetime boolean: a shutdown + re-init gets a fresh
+# subscription on the new worker's loop.
 _routers: "Any" = None
-_sub_started = False
+_sub_cw: "Any" = None  # weakref to the core worker currently subscribed
+
+
+def _ttl_warning() -> None:
+    from ray_tpu.utils import get_logger
+    get_logger("serve").warning(
+        "serve router push-invalidation unavailable; falling back "
+        "to the %ss table TTL", Router._TABLE_TTL_S)
 
 
 def _register_router(router: "Router") -> None:
-    global _routers, _sub_started
+    global _routers, _sub_cw
     import weakref
 
     if _routers is None:
         _routers = weakref.WeakSet()
     _routers.add(router)
-    if _sub_started:
-        return
     try:
         from ray_tpu.core.pubsub import Subscription
         from ray_tpu.core.ref import get_core_worker
         cw = get_core_worker()
+    except Exception:
+        _ttl_warning()  # no runtime (unit tests): TTL still refreshes
+        return
+    if _sub_cw is not None and _sub_cw() is cw:
+        return  # this worker already runs the subscription
 
-        def _invalidate(_event):
-            for r in list(_routers):
-                r._checked = 0.0  # next choose re-reads the table
+    def _invalidate(_event):
+        for r in list(_routers):
+            r._checked = 0.0  # next choose re-reads the table
 
-        async def _start():
+    async def _start():
+        global _sub_cw
+        try:
             Subscription(cw.controller, "serve_events", _invalidate,
                          from_latest=True).start()
+        except Exception:
+            _sub_cw = None  # a later router retries
+            _ttl_warning()
 
-        cw._spawn(_start())
-        _sub_started = True
-    except Exception:
-        # No runtime (unit tests) or init race: the TTL below still
-        # refreshes — but 15x slower than a push, so say so.
-        from ray_tpu.utils import get_logger
-        get_logger("serve").warning(
-            "serve router push-invalidation unavailable; falling back "
-            "to the %ss table TTL", Router._TABLE_TTL_S)
+    _sub_cw = weakref.ref(cw)
+    cw._spawn(_start())
 
 
 class Router:

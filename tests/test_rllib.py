@@ -82,8 +82,8 @@ def test_replay_buffers():
     assert len(rb) == 100  # ring wrapped (150 added)
     s = rb.sample(64)
     assert s["obs"].shape == (64, 2)
-    # Wrapped ring holds only the newest 100 rows: values 2..4 (30 of 2
-    # remain after the 150-row stream wraps the 100 ring) — value 0 gone.
+    # 150 rows through a 100 ring: rows of value 0 are fully overwritten
+    # (10 rows of value 1 survive, all of 2..4) — min can be 1, never 0.
     assert s["rew"].min() >= 1.0
 
     prb = PrioritizedReplayBuffer(capacity=64, seed=0)
