@@ -1244,6 +1244,29 @@ class CoreWorker:
         if key not in self._class_pumps:
             self._class_pumps[key] = asyncio.ensure_future(self._pump(key))
 
+    def _preferred_agent_for(self, spec: TaskSpec) -> Optional[Address]:
+        """Locality-aware lease target: the node already holding the most
+        stored-arg bytes (reference: src/ray/core_worker/lease_policy.cc
+        — the best node by object bytes local). Only self-owned stored
+        args count (the ledger knows their locations and sizes); inline
+        args travel with the spec and have no locality."""
+        threshold = GlobalConfig.locality_min_bytes
+        by_addr: Dict[Address, int] = {}
+        for a in spec.args:
+            kind, rest = (a[1], a[2:]) if a[0] == "p" else (a[2], a[3:])
+            if kind != "r":
+                continue
+            e = self.objects.get(rest[0])
+            if e is None or not e.locations or not e.size:
+                continue
+            for _node_id, addr in e.locations:
+                t = tuple(addr)
+                by_addr[t] = by_addr.get(t, 0) + e.size
+        if not by_addr:
+            return None
+        best = max(by_addr, key=lambda k: by_addr[k])
+        return best if by_addr[best] >= threshold else None
+
     async def _pump(self, key: tuple) -> None:
         """Acquire leases while the class has backlog; one denied-lease
         poller per CLASS (not per task)."""
@@ -1272,16 +1295,44 @@ class CoreWorker:
                     continue
                 spec0 = q[0][0]
 
+                # Locality: tasks whose stored args live on a remote node
+                # lease THERE first, so data-heavy args never cross nodes.
+                preferred = None
+                if spec0.placement_group is None \
+                        and spec0.scheduling_strategy is None:
+                    preferred = self._preferred_agent_for(spec0)
+                    if preferred is not None and \
+                            tuple(preferred) == tuple(self.agent_addr):
+                        preferred = None
+
                 async def _request_one():
                     # Start the runner THE MOMENT a grant lands: siblings
                     # of this wave park server-side for the queue-wait
                     # budget, and a gather-then-start would leave granted
                     # workers idle exactly that long (measured 10x burst
                     # slowdown when a wave mixes grants and parks).
-                    r = await self.agent.call(
-                        "request_lease", spec0.resources,
-                        spec0.placement_group, spec0.pg_bundle_index,
-                        spec0.scheduling_strategy, spec0.label_selector)
+                    r = None
+                    if preferred is not None:
+                        try:
+                            # Short queue-wait probe: a busy preferred
+                            # node must not stall the local fallback.
+                            r = await self._client_for_worker(
+                                tuple(preferred)).call(
+                                "request_lease", spec0.resources,
+                                None, -1, None, spec0.label_selector,
+                                _no_spill=True, queue_wait_ms=50)
+                        except Exception:
+                            r = None
+                        if r and r.get("granted"):
+                            r["spilled_to"] = tuple(preferred)
+                        else:
+                            r = None  # preferred busy: go local
+                    if r is None:
+                        r = await self.agent.call(
+                            "request_lease", spec0.resources,
+                            spec0.placement_group, spec0.pg_bundle_index,
+                            spec0.scheduling_strategy,
+                            spec0.label_selector)
                     if r.get("granted"):
                         runner = asyncio.ensure_future(
                             self._lease_runner(key, r))
@@ -1733,7 +1784,12 @@ class CoreWorker:
                     while (n < cap and n < len(buf)
                            and not _spec_has_ref_args(buf[n][0])
                            and buf[n][0].max_retries
-                           == buf[0][0].max_retries):
+                           == buf[0][0].max_retries
+                           # Same method only: a fast probe must never
+                           # wait on a batch of slow calls (async actors
+                           # reply per batch, not per member).
+                           and buf[n][0].method_name
+                           == buf[0][0].method_name):
                         n += 1
                 batch = buf[:n]
                 del buf[:n]

@@ -520,14 +520,17 @@ class NodeAgent:
     async def request_lease(self, resources: dict, pg: Optional[bytes] = None,
                             bundle_index: int = -1, strategy=None,
                             label_selector: Optional[dict] = None,
-                            _no_spill: bool = False) -> dict:
+                            _no_spill: bool = False,
+                            queue_wait_ms: Optional[int] = None) -> dict:
         """Grant a worker lease, parking the request SERVER-SIDE while
         resources are busy (reference: cluster_lease_manager.cc queues leases
         and replies when granted, rather than making clients poll). The
         request waits up to ``lease_queue_wait_ms`` on the resource condvar;
         only then does the client see retry=True and re-request."""
         loop = asyncio.get_running_loop()
-        deadline = loop.time() + GlobalConfig.lease_queue_wait_ms / 1000
+        deadline = loop.time() + (
+            queue_wait_ms if queue_wait_ms is not None
+            else GlobalConfig.lease_queue_wait_ms) / 1000
         while True:
             # Placement-group tasks must run on the bundle's node.
             if pg is not None and (pg, bundle_index) not in self.bundle_available \
@@ -547,10 +550,13 @@ class NodeAgent:
                                                         bundle_index, strategy)
                     return {"granted": False, "retry": True}
 
-            # Label constraints: this node must match to grant locally
-            # (PG tasks inherit their bundle's placement instead).
-            local_ok = pg is not None or labels_match(self.labels,
-                                                      label_selector)
+            # Label + strategy constraints: this node must satisfy both
+            # to grant locally (PG tasks inherit their bundle's placement
+            # instead). A hard node_affinity for ANOTHER node must spill
+            # there even when this node has capacity.
+            local_ok = pg is not None or (
+                labels_match(self.labels, label_selector)
+                and self._strategy_allows_local(strategy))
             avail = (self.bundle_available.get((pg, bundle_index))
                      if pg is not None else self.resources_available)
             if not local_ok:
@@ -584,6 +590,14 @@ class NodeAgent:
             # something frees up or the queue-wait budget expires.
             if not await self._park_until(deadline):
                 return {"granted": False, "retry": True}
+
+    def _strategy_allows_local(self, strategy) -> bool:
+        if not isinstance(strategy, dict):
+            return True
+        if strategy.get("kind") == "node_affinity":
+            return (strategy.get("node_id") == self.node_id.binary()
+                    or bool(strategy.get("soft")))
+        return True  # spread balances via the controller's pick
 
     async def _park_until(self, deadline: float) -> bool:
         """Wait for a resource-availability change until `deadline`.

@@ -100,7 +100,11 @@ class EnvRunner:
         values = _np_forward(vf, roll["obs"])[:, 0]
         v_boot = float(_np_forward(vf, roll["bootstrap_obs"][None, :])
                        [0, 0])
-        trunc_vals = _np_forward(vf, roll["trunc_obs"])[:, 0]
+        # V only at actual truncation rows (usually none or a handful).
+        trunc_vals = np.zeros(num_steps, np.float32)
+        idx = np.nonzero(roll["truncs"] > 0)[0]
+        if len(idx):
+            trunc_vals[idx] = _np_forward(vf, roll["trunc_obs"][idx])[:, 0]
 
         # GAE(lambda) advantages + returns. The recursion resets across
         # episode boundaries (term OR trunc); truncation bootstraps from

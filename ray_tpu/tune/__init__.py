@@ -19,14 +19,16 @@ concurrency cap; ASHA stops under-performers at rungs.
     best = grid.get_best_result()
 """
 
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
-from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
-                                 uniform)
-from ray_tpu.tune.trial import report
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     PBTScheduler)
+from ray_tpu.tune.search import (BasicVariantGenerator, Searcher, choice,
+                                 grid_search, loguniform, randint, uniform)
+from ray_tpu.tune.trial import get_checkpoint, report
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
 
 __all__ = [
-    "ASHAScheduler", "FIFOScheduler", "ResultGrid", "TrialResult",
-    "TuneConfig", "Tuner", "choice", "grid_search", "loguniform", "randint",
-    "report", "uniform",
+    "ASHAScheduler", "BasicVariantGenerator", "FIFOScheduler",
+    "PBTScheduler", "ResultGrid", "Searcher", "TrialResult", "TuneConfig",
+    "Tuner", "choice", "get_checkpoint", "grid_search", "loguniform",
+    "randint", "report", "uniform",
 ]

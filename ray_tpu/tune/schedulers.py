@@ -61,3 +61,90 @@ class ASHAScheduler:
                     else metric_value >= cutoff
                 return CONTINUE if good else STOP
         return CONTINUE
+
+
+EXPLOIT = "EXPLOIT"
+
+
+class PBTScheduler:
+    """Population Based Training (reference:
+    python/ray/tune/schedulers/pbt.py PopulationBasedTraining — Jaderberg
+    et al. 2017). Every `perturbation_interval` iterations, a trial in
+    the bottom quantile EXPLOITS a top-quantile peer: the tuner restarts
+    it from the peer's checkpoint with perturbed hyperparameters.
+    Trainables must save state via tune.report(..., checkpoint=...) and
+    resume via tune.get_checkpoint().
+
+    on_result returns CONTINUE, STOP, or ("EXPLOIT", source_trial_id,
+    mutated_config_delta)."""
+
+    def __init__(self, *, hyperparam_mutations: Dict[str, Any],
+                 perturbation_interval: int = 5,
+                 quantile_fraction: float = 0.25,
+                 metric: Optional[str] = None, mode: str = "max",
+                 perturbation_factors=(0.8, 1.2), seed: int = 0):
+        import random as _random
+        assert mode in ("min", "max")
+        assert 0 < quantile_fraction <= 0.5
+        self.mutations = hyperparam_mutations
+        self.interval = perturbation_interval
+        self.quantile = quantile_fraction
+        self.metric = metric
+        self.mode = mode
+        self.factors = perturbation_factors
+        self._rng = _random.Random(seed)
+        self._latest: Dict[str, float] = {}       # trial -> last metric
+        self._configs: Dict[str, dict] = {}       # trial -> live config
+        self._last_perturb: Dict[str, int] = {}
+
+    def track(self, trial_id: str, config: dict) -> None:
+        """The tuner registers each trial's (live) config."""
+        self._configs[trial_id] = dict(config)
+        self._last_perturb.setdefault(trial_id, 0)
+
+    def _quantiles(self):
+        ranked = sorted(self._latest.items(), key=lambda kv: kv[1],
+                        reverse=self.mode == "max")
+        n = max(1, int(len(ranked) * self.quantile))
+        top = [t for t, _ in ranked[:n]]
+        bottom = [t for t, _ in ranked[-n:]] if len(ranked) > 1 else []
+        return top, bottom
+
+    def _mutate(self, config: dict) -> dict:
+        """Perturb each mutated hyperparam: resample with p=0.25, else
+        scale by a perturbation factor (the reference's explore())."""
+        from ray_tpu.tune.search import Domain
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            old = out.get(key)
+            if self._rng.random() < 0.25 or old is None \
+                    or not isinstance(old, (int, float)):
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self._rng)
+                elif isinstance(spec, (list, tuple)):
+                    out[key] = self._rng.choice(list(spec))
+                elif callable(spec):
+                    out[key] = spec()
+            else:
+                out[key] = old * self._rng.choice(self.factors)
+                if isinstance(old, int):
+                    out[key] = max(1, int(round(out[key])))
+        return out
+
+    def on_result(self, trial_id: str, iteration: int,
+                  metric_value: float):
+        self._latest[trial_id] = metric_value
+        if iteration - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = iteration
+        if len(self._latest) < 2:
+            return CONTINUE
+        top, bottom = self._quantiles()
+        if trial_id not in bottom or trial_id in top:
+            return CONTINUE
+        source = self._rng.choice(top)
+        if source == trial_id:
+            return CONTINUE
+        new_config = self._mutate(self._configs.get(source, {}))
+        self._configs[trial_id] = new_config
+        return (EXPLOIT, source, new_config)

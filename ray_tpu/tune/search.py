@@ -101,3 +101,41 @@ def generate_variants(param_space: Dict[str, Any], num_samples: int,
                 else:
                     cfg[k] = v
             yield cfg
+
+
+# ---------------------------------------------------------------------------
+# Searcher seam (reference: python/ray/tune/search/searcher.py Searcher +
+# basic_variant.py BasicVariantGenerator): pluggable suggestion
+# algorithms — the Tuner asks `suggest(trial_id)` for each trial's config
+# and feeds completions back for adaptive searchers.
+# ---------------------------------------------------------------------------
+
+class Searcher:
+    """Base: subclass and implement suggest(); optionally learn from
+    on_trial_complete()."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Random/grid sampling over a param space — the default search
+    behavior expressed through the Searcher seam."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._variants = list(generate_variants(param_space, num_samples,
+                                                seed))
+        self._i = 0
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._i >= len(self._variants):
+            return None
+        v = self._variants[self._i]
+        self._i += 1
+        return v

@@ -20,29 +20,47 @@ PENDING, RUNNING, TERMINATED, ERROR, STOPPED = (
 
 
 class _TuneSession:
-    def __init__(self):
+    def __init__(self, restored: Optional[bytes] = None,
+                 start_iteration: int = 0):
         self.lock = threading.Lock()
         self.reported: List[Dict[str, Any]] = []
-        self.iteration = 0
+        self.iteration = start_iteration
         self.stop_requested = False
         self.finished = False
         self.error: Optional[str] = None
+        self.checkpoint: Optional[bytes] = None  # latest saved state
+        self.restored = restored                 # state to resume from
 
 
 _session: Optional[_TuneSession] = None
 
 
-def report(metrics: Dict[str, Any]) -> None:
+def report(metrics: Dict[str, Any], *,
+           checkpoint: Any = None) -> None:
     """Report one iteration's metrics from inside a trainable (reference:
-    ray.tune.report). Raises StopIteration-like exit when the scheduler
-    stopped this trial."""
+    ray.tune.report, with checkpoint= as in train.report). Raises
+    StopIteration-like exit when the scheduler stopped this trial.
+    Checkpoints make the trial PBT-exploitable."""
     if _session is None:
         raise RuntimeError("tune.report() called outside a trial")
     with _session.lock:
         _session.iteration += 1
         _session.reported.append(dict(metrics))
+        if checkpoint is not None:
+            _session.checkpoint = cloudpickle.dumps(checkpoint)
         if _session.stop_requested:
             raise _TrialStopped()
+
+
+def get_checkpoint() -> Any:
+    """State this trial should resume from (None on a fresh start;
+    a PBT exploit restarts the trial with the source's checkpoint —
+    reference: ray.tune.get_checkpoint)."""
+    if _session is None:
+        raise RuntimeError("tune.get_checkpoint() outside a trial")
+    if _session.restored is None:
+        return None
+    return cloudpickle.loads(_session.restored)
 
 
 class _TrialStopped(BaseException):
@@ -52,9 +70,11 @@ class _TrialStopped(BaseException):
 class TrialRunner:
     """Actor hosting one trial's trainable function."""
 
-    def __init__(self, fn_blob: bytes, config: dict):
+    def __init__(self, fn_blob: bytes, config: dict,
+                 restored: Optional[bytes] = None,
+                 start_iteration: int = 0):
         global _session
-        self._session = _TuneSession()
+        self._session = _TuneSession(restored, start_iteration)
         _session = self._session
         fn = cloudpickle.loads(fn_blob)
 
@@ -87,3 +107,8 @@ class TrialRunner:
     def stop_trial(self) -> None:
         with self._session.lock:
             self._session.stop_requested = True
+
+    def get_trial_checkpoint(self) -> Optional[bytes]:
+        """Latest checkpoint blob (PBT exploit source)."""
+        with self._session.lock:
+            return self._session.checkpoint

@@ -30,7 +30,8 @@ class TuneConfig:
     mode: str = "min"                 # or "max"
     num_samples: int = 1
     max_concurrent_trials: int = 4
-    scheduler: Any = None             # FIFOScheduler | ASHAScheduler
+    scheduler: Any = None             # FIFO | ASHA | PBT
+    search_alg: Any = None            # Searcher (suggest/on_trial_complete)
     seed: Optional[int] = None
     resources_per_trial: Dict[str, float] = field(default_factory=dict)
 
@@ -92,10 +93,23 @@ class Tuner:
         scheduler = cfg.scheduler or FIFOScheduler()
         if getattr(scheduler, "metric", "x") is None:
             scheduler.metric = cfg.metric
-        variants = list(generate_variants(self._space, cfg.num_samples,
-                                          cfg.seed))
+        if cfg.search_alg is not None:
+            # Searcher seam (reference: search/searcher.py): the search
+            # algorithm proposes each trial's config.
+            variants = []
+            for i in range(cfg.num_samples):
+                v = cfg.search_alg.suggest(f"trial_{i:05d}")
+                if v is None:
+                    break
+                variants.append(v)
+        else:
+            variants = list(generate_variants(self._space, cfg.num_samples,
+                                              cfg.seed))
         trials = [TrialResult(trial_id=f"trial_{i:05d}", config=v)
                   for i, v in enumerate(variants)]
+        if hasattr(scheduler, "track"):  # PBT needs live configs
+            for t in trials:
+                scheduler.track(t.trial_id, t.config)
         pending = list(trials)
         running: Dict[str, Any] = {}   # trial_id -> actor handle
         stopping: set = set()
@@ -144,7 +158,7 @@ class Tuner:
                                   + i)
                             decision = scheduler.on_result(
                                 tid, it, float(m[metric]))
-                            if decision == STOP:
+                            if decision != CONTINUE:
                                 break
                     if decision == STOP:
                         stopping.add(tid)
@@ -152,6 +166,20 @@ class Tuner:
                             actor.stop_trial.remote()
                         except Exception:
                             pass
+                    elif isinstance(decision, tuple) \
+                            and decision[0] == "EXPLOIT" \
+                            and not p["finished"]:
+                        # PBT: restart this trial from the source's
+                        # checkpoint with the mutated config. A trial
+                        # whose SAME poll already reported finished is
+                        # past exploiting (the replacement would be
+                        # killed by the done-handling below).
+                        _, source_tid, new_config = decision
+                        replaced = self._exploit(
+                            actor_cls, running, by_id, tid, source_tid,
+                            new_config)
+                        if replaced is not None:
+                            running[tid] = replaced
                 if p["finished"]:
                     if p["error"]:
                         t.status = ERROR
@@ -168,6 +196,38 @@ class Tuner:
                     pass
             if running:
                 time.sleep(0.2)
+        if cfg.search_alg is not None:
+            for t in trials:
+                cfg.search_alg.on_trial_complete(
+                    t.trial_id, t.metrics or None, error=t.status == ERROR)
         logger.info("tune finished: %d trials (%d errors)", len(trials),
                     sum(1 for t in trials if t.status == ERROR))
         return ResultGrid(trials, cfg.metric, cfg.mode)
+
+    def _exploit(self, actor_cls, running, by_id, tid: str,
+                 source_tid: str, new_config: dict):
+        """PBT exploit: clone the source's checkpoint into a replacement
+        actor for `tid` running `new_config` (reference: pbt.py
+        _exploit — checkpoint copy + explore)."""
+        source = running.get(source_tid)
+        if source is None:
+            return None  # source finished: skip this round
+        try:
+            ckpt = ray_tpu.get(source.get_trial_checkpoint.remote(),
+                               timeout=60)
+        except Exception:
+            return None
+        if ckpt is None:
+            return None  # source never checkpointed: nothing to copy
+        t = by_id[tid]
+        old = running[tid]
+        try:
+            ray_tpu.kill(old)
+        except Exception:
+            pass
+        t.config = dict(new_config)
+        logger.info("PBT exploit: %s <- %s (config %s)", tid, source_tid,
+                    new_config)
+        return actor_cls.remote(self._fn_blob, dict(new_config),
+                                restored=ckpt,
+                                start_iteration=t.iterations)

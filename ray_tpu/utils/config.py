@@ -75,6 +75,7 @@ _flag("lease_queue_wait_ms", int, 1000, "Server-side park time for an unsatisfia
 _flag("worker_lease_pipeline_depth", int, 16, "Task pushes kept in flight per leased worker (hides RPC latency; execution on the worker stays serial).")
 _flag("worker_pool_max_idle_workers", int, 8, "Idle workers kept warm per node.")
 _flag("worker_prestart", int, 0, "Workers to spawn at agent startup (reference: worker_pool.cc PrestartWorkers) — warm pools make burst workloads spawn-free.")
+_flag("locality_min_bytes", int, 128 * 1024, "Stored-arg bytes on a node before a task prefers leasing there (reference: lease_policy.cc locality-aware scheduling).")
 _flag("worker_pool_idle_ttl_s", int, 300, "Kill idle workers after this long.")
 
 # --- streaming generators ---

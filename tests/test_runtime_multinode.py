@@ -87,6 +87,45 @@ def test_placement_group_pack(cluster2):
 
 
 @pytest.mark.slow
+def test_locality_aware_lease_targeting(cluster2):
+    """A task whose big stored arg lives on node B leases on node B
+    instead of pulling the data across nodes (reference:
+    lease_policy.cc locality-aware best node)."""
+    import os
+
+    import numpy as np
+
+    nodes = ray_tpu.nodes()
+
+    @ray_tpu.remote
+    def whereami():
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    @ray_tpu.remote
+    def produce():
+        import numpy as np
+        return (os.environ["RAY_TPU_NODE_ID"],
+                np.zeros(1_000_000, np.uint8))  # ~1MB: stored, not inline
+
+    @ray_tpu.remote
+    def consume(pair):
+        return os.environ["RAY_TPU_NODE_ID"], int(pair[1].sum())
+
+    # Pin the producer to a non-driver node via node affinity.
+    driver_node = ray_tpu.get(whereami.remote())
+    other = next(n for n in nodes if n["node_id"].hex() != driver_node)
+    ref = produce.options(scheduling_strategy={
+        "kind": "node_affinity", "node_id": other["node_id"],
+        "soft": False}).remote()
+    (prod_node, _data) = ray_tpu.get(ref)
+    assert prod_node == other["node_id"].hex()
+    # The consumer should follow the data.
+    cons_node, total = ray_tpu.get(consume.remote(ref), timeout=60)
+    assert total == 0
+    assert cons_node == prod_node, (cons_node, prod_node)
+
+
+@pytest.mark.slow
 def test_node_failure_actor_restart_on_other_node():
     c = Cluster(num_nodes=1, resources={"CPU": 4})
     doomed = c.add_node(resources={"CPU": 4, "side": 1.0})

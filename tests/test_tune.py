@@ -94,3 +94,66 @@ def test_trial_failure_isolated(cluster):
     ok = [r for r in grid if r.status == "TERMINATED"]
     assert len(ok) == 2
     assert grid.get_best_result().metrics["loss"] == 1.0
+
+
+def test_pbt_exploits_and_perturbs(cluster):
+    """PBT: a bottom-quantile trial restarts from a top trial's
+    checkpoint with perturbed hyperparams (reference: schedulers/pbt.py).
+    Trainable: score grows by lr each iter — exploiting copies the best
+    score so everyone converges toward the top lr's trajectory."""
+    from ray_tpu import tune
+
+    def trainable(config):
+        state = tune.get_checkpoint() or {"score": 0.0}
+        score = state["score"]
+        for _ in range(20):
+            score += config["lr"]
+            tune.report({"score": score}, checkpoint={"score": score})
+
+    pbt = tune.PBTScheduler(
+        hyperparam_mutations={"lr": tune.uniform(0.1, 2.0)},
+        perturbation_interval=4, quantile_fraction=0.34,
+        metric="score", mode="max", seed=7)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.choice([0.01, 0.02, 2.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=3,
+            max_concurrent_trials=3, scheduler=pbt, seed=5),
+    ).fit()
+    assert grid.num_errors() == 0
+    best = grid.get_best_result()
+    assert best.metrics["score"] > 20 * 0.5  # far above the 0.01-lr path
+    # At least one laggard was exploited: its final score outruns what
+    # its ORIGINAL lr could ever reach alone (20 * 0.02 = 0.4).
+    others = sorted(r.metrics["score"] for r in grid)[:-1]
+    assert any(s > 1.0 for s in others), others
+
+
+def test_searcher_seam(cluster):
+    """A custom Searcher drives trial configs via suggest() and hears
+    completions (reference: search/searcher.py)."""
+    from ray_tpu import tune
+
+    class FixedSearcher(tune.Searcher):
+        def __init__(self):
+            self.completed = []
+
+        def suggest(self, trial_id):
+            return {"x": int(trial_id[-1])}
+
+        def on_trial_complete(self, trial_id, result=None, error=False):
+            self.completed.append((trial_id, error))
+
+    def trainable(config):
+        tune.report({"loss": (config["x"] - 2) ** 2})
+
+    searcher = FixedSearcher()
+    grid = tune.Tuner(
+        trainable, tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=4,
+            search_alg=searcher),
+    ).fit()
+    assert len(grid) == 4
+    assert grid.get_best_result().config == {"x": 2}
+    assert len(searcher.completed) == 4
