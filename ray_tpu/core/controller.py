@@ -517,7 +517,8 @@ class Controller:
                         # not kill an actor whose restart budget is spent.
                         max_restarts=(-1 if entry.max_restarts == -1 else
                                       max(0, entry.max_restarts
-                                          - entry.restarts_used)))
+                                          - entry.restarts_used)),
+                        pip=entry.runtime_env.get("pip"))
                     entry.addr = tuple(reply["addr"])
                     entry.node_id = node.node_id
                     entry.state = ActorState.ALIVE

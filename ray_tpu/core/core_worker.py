@@ -917,7 +917,8 @@ class CoreWorker:
         return self._run(self.get_async(ref))
 
     async def get_async(self, ref: ObjectRef,
-                        timeout: Optional[float] = None) -> Any:
+                        timeout: Optional[float] = None,
+                        _priority: int = 0) -> Any:
         oid = ref.binary()
         if self._is_self_owned(ref):
             e = await self._wait_entry_ready(oid, timeout)
@@ -925,7 +926,7 @@ class CoreWorker:
                 raise e.error
             if e.inline is not None:
                 return serialization.deserialize(e.inline[0], e.inline[1])
-            return await self._get_from_store(oid, e)
+            return await self._get_from_store(oid, e, _priority)
         # Borrowed ref: ask the owner.
         owner = self._client_for_worker(tuple(ref.owner_addr))
         deadline = None if timeout is None else \
@@ -949,10 +950,11 @@ class CoreWorker:
         if status["status"] == "inline":
             return serialization.deserialize(status["data"], status["meta"])
         return await self._fetch_stored(oid, status["locations"],
-                                        ref.owner_addr)
+                                        ref.owner_addr, _priority)
 
-    async def _get_from_store(self, oid: bytes, e: ObjectEntry) -> Any:
-        ok = await self._ensure_local(oid, list(e.locations))
+    async def _get_from_store(self, oid: bytes, e: ObjectEntry,
+                              priority: int = 0) -> Any:
+        ok = await self._ensure_local(oid, list(e.locations), priority)
         if not ok:
             # All copies lost: try lineage reconstruction.
             if e.creating_task is not None:
@@ -968,20 +970,23 @@ class CoreWorker:
                     f"object {ObjectID(oid)} lost (all copies gone)")
         return await self._map_local(oid)
 
-    async def _fetch_stored(self, oid: bytes, locations, owner_addr) -> Any:
-        ok = await self._ensure_local(oid, locations)
+    async def _fetch_stored(self, oid: bytes, locations, owner_addr,
+                            priority: int = 0) -> Any:
+        ok = await self._ensure_local(oid, locations, priority)
         if not ok:
             raise ObjectLostError(f"object {ObjectID(oid)} lost")
         return await self._map_local(oid)
 
-    async def _ensure_local(self, oid: bytes, locations) -> bool:
+    async def _ensure_local(self, oid: bytes, locations,
+                            priority: int = 0) -> bool:
         if await self.agent.call("store_contains", oid) == 1:
             return True
         for node_id, addr in locations:
             if node_id == self.node_id:
                 continue  # local agent lost it; try others
             try:
-                await self.agent.call("pull_object", oid, tuple(addr))
+                await self.agent.call("pull_object", oid, tuple(addr),
+                                      priority)
                 return True
             except Exception as e:
                 logger.debug("pull of %s from %s failed: %r",
@@ -2148,7 +2153,9 @@ class CoreWorker:
             else:
                 ref = ObjectRef(ObjectID(rest[0]), tuple(rest[1]))
                 self.on_ref_deserialized(ref)
-                val = await self.get_async(ref)
+                # Task-arg prefetch: lowest pull priority (reference:
+                # pull_manager.cc get > wait > task args).
+                val = await self.get_async(ref, _priority=2)
             if key is None:
                 args.append(val)
             else:

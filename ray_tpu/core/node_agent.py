@@ -61,6 +61,59 @@ class _ExternalProc:
         pass  # never kill processes we don't own
 
 
+class PullScheduler:
+    """Priority-admitted, bounded-concurrency transfer slots (reference:
+    src/ray/object_manager/pull_manager.cc — get > wait > task-arg
+    priorities, bandwidth-bounded active pulls, and a get request
+    RE-prioritizes an already-queued pull). Priorities: 0 = ray.get,
+    1 = ray.wait, 2 = task-arg prefetch."""
+
+    def __init__(self, max_concurrent: int):
+        self.max_concurrent = max_concurrent
+        self._active = 0
+        self._seq = 0
+        self._waiters: list = []  # heap of (priority, seq, token)
+
+    async def acquire(self, priority: int,
+                      token: Optional[dict] = None) -> dict:
+        """Returns the slot token (pass to promote/release). A caller
+        may pre-create the token to share it (dedup promotion) before
+        awaiting admission."""
+        import heapq
+        if token is None:
+            token = {"ev": asyncio.Event(), "granted": False}
+        if self._active < self.max_concurrent:
+            self._active += 1
+            token["granted"] = True
+            return token
+        self._seq += 1
+        heapq.heappush(self._waiters, (priority, self._seq, token))
+        await token["ev"].wait()
+        return token
+
+    def promote(self, token: dict, priority: int) -> None:
+        """Move a queued token to a better priority (a ray.get landing on
+        an in-flight prefetch must not inherit its queue position)."""
+        import heapq
+        if token.get("granted"):
+            return
+        self._seq += 1
+        # The old heap entry stays as a stale duplicate; release() skips
+        # already-granted tokens, so only the first pop wins.
+        heapq.heappush(self._waiters, (priority, self._seq, token))
+
+    def release(self) -> None:
+        import heapq
+        while self._waiters:
+            _, _, token = heapq.heappop(self._waiters)
+            if token.get("granted"):
+                continue  # stale duplicate from promote()
+            token["granted"] = True
+            token["ev"].set()  # slot hand-off
+            return
+        self._active -= 1
+
+
 class WorkerProc:
     def __init__(self, proc: subprocess.Popen, worker_id: bytes):
         self.proc = proc
@@ -84,6 +137,7 @@ class NodeAgent:
         self.controller = RpcClient(controller_addr)
         self.host = host
         self.resources_total = dict(resources)
+        self._venv_locks: Dict[str, asyncio.Lock] = {}
         self.labels = dict(labels or {})
         # TPU accelerator manager: advertise chips as a first-class resource
         # + slice/topology labels (reference: accelerators/tpu.py:199,564).
@@ -113,7 +167,10 @@ class NodeAgent:
         self.store = LocalObjectStore(
             store_dir, GlobalConfig.object_store_memory_bytes)
         self._seal_waiters: Dict[bytes, asyncio.Event] = {}
-        self._pulls: Dict[bytes, asyncio.Future] = {}
+        self._pulls: Dict[bytes, tuple] = {}  # oid -> (future, slot token)
+        self._pull_sched = PullScheduler(
+            GlobalConfig.max_concurrent_object_pulls)
+        self._push_rx: Dict[bytes, str] = {}  # in-flight inbound pushes
         # Primary-copy ledger + spill state (reference:
         # src/ray/raylet/local_object_manager.cc pins primaries and spills
         # them to disk under memory pressure; restore on demand). Insertion
@@ -379,8 +436,66 @@ class NodeAgent:
     # ------------------------------------------------------------------
     # worker pool (reference: src/ray/raylet/worker_pool.cc)
     # ------------------------------------------------------------------
-    def _spawn_worker(self, extra_env: Optional[Dict[str, str]] = None
-                      ) -> WorkerProc:
+    async def _ensure_pip_env(self, pip: List[str]) -> str:
+        """Create (or reuse) a per-content venv with the requested
+        packages (reference: python/ray/_private/runtime_env/pip.py —
+        one cached venv per requirements hash; --system-site-packages so
+        the runtime's own deps stay visible). Returns the venv's python.
+
+        Offline-friendly: local directories/wheels install with
+        --no-build-isolation; index packages need egress."""
+        import hashlib
+        key = hashlib.sha1("\n".join(sorted(pip)).encode()).hexdigest()[:16]
+        venv_dir = os.path.join(self.session_dir, "venvs", key)
+        python = os.path.join(venv_dir, "bin", "python")
+        ready = os.path.join(venv_dir, "READY")
+        if os.path.exists(ready):
+            return python
+        lock = self._venv_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            if os.path.exists(ready):
+                return python
+            loop = asyncio.get_running_loop()
+
+            def _build():
+                import glob
+                import venv as venv_mod
+                tmp = f"{venv_dir}.tmp-{os.getpid()}"
+                venv_mod.create(tmp, system_site_packages=True,
+                                with_pip=True)
+                # The agent may itself run inside a venv; system_site_
+                # packages then exposes the BASE python's site-packages,
+                # not the agent's. A .pth appends the agent environment's
+                # site-packages (jax, setuptools, ...) AFTER the new
+                # venv's own — installed packages still win.
+                parent_sp = [p for p in sys.path
+                             if p.rstrip("/").endswith("site-packages")]
+                venv_sp = glob.glob(
+                    os.path.join(tmp, "lib", "python*",
+                                 "site-packages"))[0]
+                with open(os.path.join(venv_sp, "_agent_env.pth"),
+                          "w") as f:
+                    f.write("\n".join(parent_sp) + "\n")
+                cmd = [os.path.join(tmp, "bin", "python"), "-m", "pip",
+                       "install", "--no-build-isolation", "--quiet", *pip]
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=600)
+                if proc.returncode != 0:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise RuntimeError(
+                        f"pip runtime_env install failed: "
+                        f"{proc.stderr[-2000:]}")
+                open(os.path.join(tmp, "READY"), "w").close()
+                try:
+                    os.rename(tmp, venv_dir)
+                except OSError:  # raced with another agent process
+                    shutil.rmtree(tmp, ignore_errors=True)
+
+            await loop.run_in_executor(None, _build)
+            return python
+
+    def _spawn_worker(self, extra_env: Optional[Dict[str, str]] = None,
+                      python_exe: Optional[str] = None) -> WorkerProc:
         env = dict(os.environ)
         env["RAY_TPU_AGENT_ADDR"] = f"{self.host}:{self.port}"
         env["RAY_TPU_CONTROLLER_ADDR"] = \
@@ -406,7 +521,8 @@ class NodeAgent:
             # tasks must reach the driver promptly.
             env["PYTHONUNBUFFERED"] = "1"
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            [python_exe or sys.executable, "-m",
+             "ray_tpu.core.worker_main"],
             env=env, cwd=os.getcwd(),
             stdout=subprocess.PIPE if capture else None,
             stderr=subprocess.STDOUT if capture else None,
@@ -666,7 +782,8 @@ class NodeAgent:
                           resources: dict, pg: Optional[bytes],
                           bundle_index: int,
                           env_vars: Optional[Dict[str, str]] = None,
-                          max_restarts: int = 0) -> dict:
+                          max_restarts: int = 0,
+                          pip: Optional[List[str]] = None) -> dict:
         tpu_req = float(resources.get("TPU", 0))
         if tpu_req != int(tpu_req):
             # Chips are whole devices: fractional TPU would desynchronize
@@ -694,7 +811,12 @@ class NodeAgent:
                 env_vars.setdefault(k, v)
         w: Optional[WorkerProc] = None
         try:
-            w = self._spawn_worker(env_vars)  # dedicated worker, never pooled
+            # pip runtime env: the worker runs on a cached per-requirements
+            # venv's python (reference: runtime_env/pip.py). INSIDE the
+            # try: a failed venv build must roll back the resources and
+            # chips reserved above, like any other startup failure.
+            python_exe = await self._ensure_pip_env(pip) if pip else None
+            w = self._spawn_worker(env_vars, python_exe)  # dedicated, never pooled
             await asyncio.wait_for(w.ready.wait(),
                                    GlobalConfig.worker_register_timeout_s)
             w.dedicated_actor = actor_id
@@ -949,17 +1071,31 @@ class NodeAgent:
         raise KeyError(f"object not local: {ObjectID(oid)}")
 
     @long_poll
-    async def pull_object(self, oid: bytes, from_addr) -> bool:
-        """Fetch a remote object into the local store (idempotent)."""
+    async def pull_object(self, oid: bytes, from_addr,
+                          priority: int = 0) -> bool:
+        """Fetch a remote object into the local store (idempotent).
+        priority: 0 = ray.get, 1 = ray.wait, 2 = task-arg prefetch —
+        admitted through the bounded PullScheduler so a broadcast of arg
+        prefetches can't starve interactive gets."""
         o = ObjectID(oid)
         if self.store.contains(o) == 1:
             return True
-        fut = self._pulls.get(oid)
-        if fut is not None:
-            return await asyncio.shield(fut)
+        existing = self._pulls.get(oid)
+        if existing is not None:
+            fut0, token0 = existing
+            # A get landing on a queued prefetch jumps the queue with it.
+            self._pull_sched.promote(token0, priority)
+            return await asyncio.shield(fut0)
         fut = asyncio.get_running_loop().create_future()
-        self._pulls[oid] = fut
+        token = {"ev": asyncio.Event(), "granted": False}
+        self._pulls[oid] = (fut, token)
+        await self._pull_sched.acquire(priority, token)
         try:
+            # Re-check after queueing: a concurrent push may have already
+            # delivered the object while this pull waited for a slot.
+            if self.store.contains(o) == 1:
+                fut.set_result(True)
+                return True
             peer = self._peer(tuple(from_addr))
             info = await peer.call("object_info", oid)
             if info is None:
@@ -992,7 +1128,84 @@ class NodeAgent:
             fut.set_exception(e)
             raise
         finally:
+            self._pull_sched.release()
             self._pulls.pop(oid, None)
+
+    @long_poll
+    async def push_object(self, oid: bytes, target_addr) -> bool:
+        """PUSH a local object to a peer node (reference:
+        object_manager.cc:321 Push — the proactive half of the transfer
+        plane; broadcast producers ship copies without N pull round
+        trips). Chunked through the same transfer framing as pulls."""
+        o = ObjectID(oid)
+        got = self.store.get(o)
+        if got is None:
+            raise KeyError(f"object not local: {o}")
+        path, ds, ms = got
+        try:
+            peer = self._peer(tuple(target_addr))
+            wanted = await peer.call("receive_push_begin", oid, ds, ms)
+            if not wanted:
+                return True  # target already has it (sealed)
+            try:
+                total = ds + ms
+                chunk = GlobalConfig.object_transfer_chunk_bytes
+                with open(path, "rb") as f:
+                    off = 0
+                    while off < total:
+                        f.seek(off)
+                        data = f.read(min(chunk, total - off))
+                        await peer.call("receive_push_chunk", oid, off,
+                                        data)
+                        off += len(data)
+                await peer.call("receive_push_end", oid)
+            except BaseException:
+                # Never leave the receiver with an unsealed husk: it
+                # would poison both retried pushes and future pulls.
+                try:
+                    await peer.call("receive_push_abort", oid)
+                except Exception:
+                    pass
+                raise
+            return True
+        finally:
+            self.store.release(o)
+
+    async def receive_push_begin(self, oid: bytes, data_size: int,
+                                 meta_size: int) -> bool:
+        if self.store.contains(ObjectID(oid)) == 1:
+            return False  # already sealed locally
+        if oid in self._push_rx:
+            return True   # resume: a crashed push restarts over the file
+        path = await self.store_create(oid, data_size, meta_size)
+        self._push_rx[oid] = path
+        return True
+
+    async def receive_push_abort(self, oid: bytes) -> None:
+        if self._push_rx.pop(oid, None) is not None:
+            try:
+                self.store.delete(ObjectID(oid))
+            except Exception:
+                pass
+
+    async def receive_push_chunk(self, oid: bytes, offset: int,
+                                 data: bytes) -> None:
+        path = self._push_rx.get(oid)
+        if path is None:
+            raise KeyError(f"no push in progress for {ObjectID(oid)}")
+        fd = os.open(path, os.O_RDWR)
+        try:
+            os.pwrite(fd, data, offset)
+        finally:
+            os.close(fd)
+
+    async def receive_push_end(self, oid: bytes) -> None:
+        if self._push_rx.pop(oid, None) is None:
+            return
+        self.store.seal(ObjectID(oid))
+        ev = self._seal_waiters.pop(oid, None)
+        if ev:
+            ev.set()
 
     async def free_objects(self, oids: list) -> None:
         for oid in oids:

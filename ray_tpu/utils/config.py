@@ -66,6 +66,7 @@ _flag("object_store_memory_bytes", int, 2 * 1024**3, "Default shm arena size per
 _flag("object_store_min_spill_bytes", int, 100 * 1024**2, "Batch spills until this many bytes.")
 _flag("max_direct_call_object_size", int, 100 * 1024, "Inline results smaller than this in-process.")
 _flag("object_transfer_chunk_bytes", int, 5 * 1024**2, "Chunk size for node-to-node object transfer.")
+_flag("max_concurrent_object_pulls", int, 4, "Active inbound object transfers per node; excess pulls queue by priority (reference: pull_manager.cc bandwidth-bounded active pulls).")
 _flag("object_spill_dir", str, "", "Directory for spilled objects (default: session dir).")
 
 # --- scheduling ---

@@ -92,3 +92,37 @@ def test_package_dedup(cluster, tmp_path):
     cw = api._cw()
     keys = cw._run(cw.controller.call("kv_keys", "pkg")).result(30)
     assert keys.count(sha1) == 1
+
+
+def test_pip_venv_isolation(cluster, tmp_path):
+    """Actors with a pip runtime_env run on a per-requirements venv
+    (reference: runtime_env/pip.py): the installed package imports inside
+    the env and stays invisible outside it."""
+    pkg = tmp_path / "tinypkg"
+    (pkg / "tinypkg_rt").mkdir(parents=True)
+    (pkg / "tinypkg_rt" / "__init__.py").write_text(
+        "MAGIC = 'venv-isolated-42'\n")
+    (pkg / "setup.py").write_text(
+        "from setuptools import setup\n"
+        "setup(name='tinypkg-rt', version='0.1',"
+        " packages=['tinypkg_rt'])\n")
+
+    @ray_tpu.remote
+    class UsesPkg:
+        def magic(self):
+            import tinypkg_rt
+            return tinypkg_rt.MAGIC
+
+    a = UsesPkg.options(
+        runtime_env={"pip": [str(pkg)]}).remote()
+    assert ray_tpu.get(a.magic.remote(), timeout=300) == "venv-isolated-42"
+
+    # Isolation: a plain actor cannot import it.
+    b = UsesPkg.options().remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.magic.remote(), timeout=60)
+
+    # Cache: a second actor with the SAME requirements reuses the venv.
+    c = UsesPkg.options(
+        runtime_env={"pip": [str(pkg)]}).remote()
+    assert ray_tpu.get(c.magic.remote(), timeout=120) == "venv-isolated-42"
