@@ -811,6 +811,35 @@ class CoreWorker:
         return self._run(
             self._next_stream_item_async(task_id, index, timeout)).result()
 
+    async def _wait_stream_item_async(self, task_id: bytes, index: int,
+                                      timeout: float) -> None:
+        """Peek-wait: block until stream item `index` is ready (or the
+        stream errors/ends) WITHOUT consuming it — pollers (the Data
+        executor) park here instead of spinning on timeout=0 probes."""
+        st = self._streams.get(task_id)
+        deadline = asyncio.get_running_loop().time() + timeout
+        while st is not None:
+            if index < st.produced and index in st.refs:
+                return
+            if st.error is not None or st.released:
+                return
+            if st.total is not None and index >= st.total:
+                return
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                return
+            if st.event is None or st.event.is_set():
+                st.event = asyncio.Event()
+            try:
+                await asyncio.wait_for(st.event.wait(), remaining)
+            except asyncio.TimeoutError:
+                return
+
+    def wait_stream_item(self, task_id: bytes, index: int,
+                         timeout: float) -> None:
+        self._run(self._wait_stream_item_async(task_id, index,
+                                               timeout)).result()
+
     async def next_stream_item_async(self, task_id: bytes, index: int):
         """Variant for async consumers on THEIR OWN event loop (Serve
         replicas): the wait still runs on the core-worker io loop (stream
@@ -828,6 +857,8 @@ class CoreWorker:
         def _drop():
             if st.bp_event is not None:
                 st.bp_event.set()
+            if st.event is not None:
+                st.event.set()  # wake parked peek-waiters immediately
             for ref in st.refs.values():
                 self.remove_local_ref(ref)
             st.refs.clear()
@@ -1014,9 +1045,16 @@ class CoreWorker:
         try:
             mo = MappedObject(path, ds, ms)
             if ds + ms <= self._MAP_CACHE_ENTRY_MAX:
+                # Two concurrent misses for the same oid can interleave
+                # across the store_get await: on overwrite, subtract the
+                # replaced entry's bytes so accounting can't drift upward.
+                prev = self._map_cache.get(oid)
+                if prev is not None:
+                    self._map_cache_bytes -= len(prev.data) + len(prev.meta)
                 self._map_cache[oid] = mo
                 self._map_cache_bytes += ds + ms
-                while self._map_cache_bytes > self._MAP_CACHE_MAX_BYTES:
+                while (self._map_cache
+                       and self._map_cache_bytes > self._MAP_CACHE_MAX_BYTES):
                     old_oid, old = self._map_cache.popitem(last=False)
                     self._map_cache_bytes -= len(old.data) + len(old.meta)
             # Deserialized arrays keep views into the mapping alive; the pin
@@ -1782,12 +1820,18 @@ class CoreWorker:
                 # coalesced dependent whose upstream's reply rides the
                 # same RPC could never resolve its argument (the owner
                 # marks the upstream ready only when the batch returns).
+                # Refs nested inside containers count too — the wire arg
+                # is kind 'v' but _task_arg_refs (which includes
+                # contained refs) still holds them.
                 # And one retry budget per batch: never coalesce tasks
                 # with different max_retries.
+                def _has_refs(spec):
+                    return (_spec_has_ref_args(spec)
+                            or bool(self._task_arg_refs.get(spec.task_id)))
                 n = 1
-                if not _spec_has_ref_args(buf[0][0]):
+                if not _has_refs(buf[0][0]):
                     while (n < cap and n < len(buf)
-                           and not _spec_has_ref_args(buf[n][0])
+                           and not _has_refs(buf[n][0])
                            and buf[n][0].max_retries
                            == buf[0][0].max_retries
                            # Same method only: a fast probe must never

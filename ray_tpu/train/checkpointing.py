@@ -55,6 +55,42 @@ class Checkpoint:
         return f"Checkpoint(step={self.step}, path={self.path!r})"
 
 
+def _recover_trashed(directory: str, step: int) -> None:
+    """Crash recovery for the commit swap: a crash between the two renames
+    in save_checkpoint leaves NO step-N while the previously committed
+    checkpoint sits in _trash-step-N — rename it back so the guarantee
+    (an existing committed step stays restorable until the new save is
+    durable) holds across that microsecond window too."""
+    final_dir = os.path.join(directory, f"step-{step}")
+    trash = os.path.join(directory, f"_trash-step-{step}")
+    if (not os.path.isdir(final_dir)
+            and os.path.exists(os.path.join(trash, "COMMIT"))):
+        os.rename(trash, final_dir)
+
+
+def _recover_all_trashed(directory: str) -> None:
+    if not os.path.isdir(directory):
+        return
+    for name in os.listdir(directory):
+        if not name.startswith("_trash-step-"):
+            continue
+        try:
+            step = int(name[len("_trash-step-"):])
+        except ValueError:
+            continue
+        try:
+            _recover_trashed(directory, step)
+        except OSError:
+            continue
+        # Superseded trash (a crash landed after the final rename but
+        # before the cleanup rmtree): step-N exists, so the trash copy is
+        # garbage — delete it or it leaks a full checkpoint forever.
+        trash = os.path.join(directory, name)
+        if os.path.isdir(trash) and os.path.isdir(
+                os.path.join(directory, f"step-{step}")):
+            shutil.rmtree(trash, ignore_errors=True)
+
+
 def _index_key(index: Tuple, shape: Tuple[int, ...]) -> str:
     """Stable filename key for one shard's global slice tuple."""
     parts = []
@@ -93,9 +129,11 @@ def save_checkpoint(directory: str, state: Any, step: int,
     # (b) an existing COMMITTED step-N stays restorable until the new
     # save is fully durable.
     ckpt_dir = os.path.join(directory, f"_tmp-step-{step}")
-    if proc == 0 and os.path.isdir(ckpt_dir):
-        import shutil
-        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    if proc == 0:
+        _recover_trashed(directory, step)
+        if os.path.isdir(ckpt_dir):
+            import shutil
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt-begin-{step}")
@@ -167,6 +205,13 @@ def restore_checkpoint(ckpt: "Checkpoint | str", target: Any) -> Any:
     import jax
 
     path = ckpt.path if isinstance(ckpt, Checkpoint) else ckpt
+    if not os.path.exists(os.path.join(path, "COMMIT")):
+        base, name = os.path.split(os.path.abspath(path))
+        if name.startswith("step-"):
+            try:
+                _recover_trashed(base, int(name[len("step-"):]))
+            except (ValueError, OSError):
+                pass
     if not os.path.exists(os.path.join(path, "COMMIT")):
         raise FileNotFoundError(f"no committed checkpoint at {path}")
     with open(os.path.join(path, "_METADATA.json")) as f:
@@ -267,6 +312,7 @@ class CheckpointManager:
         """Pick up committed checkpoints already on disk (resume path)."""
         if not os.path.isdir(self.directory):
             return
+        _recover_all_trashed(self.directory)
         for name in sorted(os.listdir(self.directory)):
             if not name.startswith("step-"):
                 continue

@@ -74,6 +74,30 @@ def test_uncommitted_checkpoint_rejected(tmp_path):
     assert mgr.latest() is None
 
 
+def test_trash_recovery_after_swap_crash(tmp_path):
+    """A crash between the two commit-swap renames leaves the committed
+    step only in _trash-step-N; save/restore/discover must rename it
+    back (advisor r3 low finding)."""
+    state = _sharded_state()
+    ckpt = save_checkpoint(str(tmp_path), state, step=3)
+    # Simulate a crash mid-swap: step-3 moved to trash, new dir lost.
+    trash = os.path.join(str(tmp_path), "_trash-step-3")
+    os.rename(ckpt.path, trash)
+    assert not os.path.isdir(ckpt.path)
+    # restore_checkpoint recovers the trashed committed dir.
+    restored = restore_checkpoint(ckpt.path, state)
+    assert int(restored["step"]) == 7
+    # Again for discovery: manager sees the recovered checkpoint.
+    os.rename(ckpt.path, trash)
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.latest() is not None and mgr.latest().step == 3
+    # A fresh save of the same step also recovers first (no data loss if
+    # that save crashes pre-commit).
+    os.rename(os.path.join(str(tmp_path), "step-3"), trash)
+    save_checkpoint(str(tmp_path), state, step=3)
+    assert not os.path.isdir(trash)
+
+
 def test_manager_topk_by_metric(tmp_path):
     state = {"x": np.arange(4.0)}
     mgr = CheckpointManager(str(tmp_path), max_to_keep=2, metric="loss",

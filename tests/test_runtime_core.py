@@ -297,6 +297,31 @@ def test_dependent_actor_calls_no_batch_deadlock(cluster):
     assert ray_tpu.get(r, timeout=30) == 6
 
 
+def test_dependent_actor_calls_nested_ref_no_batch_deadlock(cluster):
+    """Same-method dependent calls where the ref is NESTED in a container
+    arg (wire kind 'v' with contained refs) must also never coalesce with
+    their upstream into one batch RPC (advisor r3 medium finding)."""
+    @ray_tpu.remote
+    class Chain:
+        def g(self, x):
+            if isinstance(x, list):
+                x = ray_tpu.get(x[0])  # in-body get on the nested ref
+            return x + 1
+
+    a = Chain.remote()
+    ray_tpu.get(a.g.remote(0))  # warm
+    # Adjacent submissions, same actor, same method: upstream + dependent
+    # with the upstream's ref hidden inside a list.
+    up = a.g.remote(0)
+    down = a.g.remote([up])
+    assert ray_tpu.get(down, timeout=30) == 2
+    # A longer same-method chain of nested-ref dependents.
+    r = a.g.remote(0)
+    for _ in range(4):
+        r = a.g.remote([r])
+    assert ray_tpu.get(r, timeout=30) == 5
+
+
 def test_async_actor_signal_concurrency(cluster):
     """A parked async method must not block the push of the call that
     unblocks it (multiple in-flight pushes per actor)."""
