@@ -2,12 +2,13 @@
 
 Analogue of the reference's Dataset (reference: python/ray/data/dataset.py —
 map:276, map_batches:457, streaming_split:1826, iter_batches:4973,
-iter_torch_batches:5044 → here iter_jax_batches). Redesigned linear:
-a Dataset is (sources, fused stage chain); every transform appends a
-block→blocks stage; execution streams blocks through one generator task per
-source (executor.py). There is no separate logical/physical optimizer pass
-because the representation IS the fused physical plan — the reference's
-fusion rule output.
+iter_torch_batches:5044 → here iter_jax_batches) over a LOGICAL PLAN that a
+small planner lowers to the operator-graph streaming executor (reference:
+_internal/logical/optimizers.py fusion rule + planner/planner.py →
+execution/streaming_executor.py). Consecutive row/batch transforms fuse
+into one map node (the fusion rule applied eagerly at plan-build time);
+actor-pool maps, all-to-all exchanges (shuffle/sort/repartition), and
+unions each lower to their own physical operator.
 """
 
 from __future__ import annotations
@@ -21,24 +22,103 @@ import ray_tpu
 _py_range = range  # the public range() below shadows the builtin
 from ray_tpu.data import datasource as _ds
 from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
-from ray_tpu.data.executor import apply_stages, execute_streaming
 from ray_tpu.data.iterator import (iter_batches_from_refs,
                                    iter_jax_batches_from_refs)
+
+
+# ---------------------------------------------------------------------------
+# logical plan nodes (reference: _internal/logical/operators/*)
+# ---------------------------------------------------------------------------
+
+class _Read:
+    """Source blocks: materialized ObjectRefs or zero-arg read callables."""
+    __slots__ = ("sources",)
+
+    def __init__(self, sources: List[Any]):
+        self.sources = sources
+
+
+class _Fused:
+    """A fused chain of block -> Iterator[block] stages (the reference's
+    map-fusion rule output)."""
+    __slots__ = ("stages",)
+
+    def __init__(self, stages: List[Callable]):
+        self.stages = stages
+
+
+class _ActorMapNode:
+    """map_batches on a pool of long-lived actors."""
+    __slots__ = ("fn", "batch_size", "batch_format", "concurrency",
+                 "ctor_args", "fn_kwargs")
+
+    def __init__(self, fn, batch_size, batch_format, concurrency,
+                 ctor_args, fn_kwargs):
+        self.fn = fn
+        self.batch_size = batch_size
+        self.batch_format = batch_format
+        self.concurrency = concurrency
+        self.ctor_args = ctor_args
+        self.fn_kwargs = fn_kwargs
+
+
+class _ExchangeNode:
+    """All-to-all barrier: fn(list of input refs) -> list of output refs
+    (repartition / random_shuffle / sort lower to this)."""
+    __slots__ = ("fn", "name", "num_blocks_hint")
+
+    def __init__(self, fn, name: str, num_blocks_hint: Optional[int] = None):
+        self.fn = fn
+        self.name = name
+        self.num_blocks_hint = num_blocks_hint
+
+
+class _UnionNode:
+    """Ordered concatenation of several sub-plans."""
+    __slots__ = ("parts",)
+
+    def __init__(self, parts: List[List[Any]]):
+        self.parts = parts
 
 
 class Dataset:
     def __init__(self, sources: List[Any], stages: Optional[List] = None,
                  name: str = "dataset"):
-        self._sources = sources  # ObjectRefs or read callables
-        self._stages = list(stages or [])
+        self._plan: List[Any] = [_Read(list(sources))]
+        if stages:
+            self._plan.append(_Fused(list(stages)))
         self._name = name
 
+    @classmethod
+    def _from_plan(cls, plan: List[Any], name: str) -> "Dataset":
+        ds = cls.__new__(cls)
+        ds._plan = plan
+        ds._name = name
+        return ds
+
+    @property
+    def _sources(self) -> List[Any]:
+        """Source list of a plain (un-transformed) dataset — the
+        materialized-refs contract shuffle.py relies on."""
+        assert len(self._plan) == 1 and isinstance(self._plan[0], _Read), \
+            f"_sources on a transformed dataset: {self._plan}"
+        return self._plan[0].sources
+
     # ------------------------------------------------------------------
-    # transforms (lazy; each appends a block -> Iterator[block] stage)
+    # transforms (lazy; each appends to the logical plan)
     # ------------------------------------------------------------------
     def _with_stage(self, stage, name: str) -> "Dataset":
-        return Dataset(self._sources, self._stages + [stage],
-                       f"{self._name}->{name}")
+        plan = list(self._plan)
+        if plan and isinstance(plan[-1], _Fused):
+            plan[-1] = _Fused(plan[-1].stages + [stage])
+        else:
+            plan.append(_Fused([stage]))
+        return Dataset._from_plan(plan, f"{self._name}->{name}")
+
+    def _with_exchange(self, fn, name: str,
+                       num_blocks_hint: Optional[int] = None) -> "Dataset":
+        plan = list(self._plan) + [_ExchangeNode(fn, name, num_blocks_hint)]
+        return Dataset._from_plan(plan, f"{self._name}->{name}")
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy",
@@ -51,18 +131,20 @@ class Dataset:
         batch per block is possible, as with the reference's default
         shuffle=False zero-copy path).
 
-        concurrency=N runs the transform on a pool of N ACTORS instead of
-        fusing it into the source tasks (reference:
-        ActorPoolMapOperator / map_batches(CallableClass, concurrency=N))
-        — pass a callable CLASS to construct once per actor (model
-        loading etc.) and call per batch."""
+        concurrency=N runs the transform on a pool of N ACTORS as its own
+        physical operator (reference: ActorPoolMapOperator /
+        map_batches(CallableClass, concurrency=N)) — pass a callable
+        CLASS to construct once per actor (model loading etc.) and call
+        per batch."""
         if concurrency is not None:
             if concurrency < 1:
                 raise ValueError(f"concurrency must be >= 1, "
                                  f"got {concurrency}")
-            return _ActorMapDataset(self, fn, batch_size, batch_format,
-                                    concurrency, fn_constructor_args,
-                                    fn_kwargs or {})
+            plan = list(self._plan) + [_ActorMapNode(
+                fn, batch_size, batch_format, concurrency,
+                fn_constructor_args, fn_kwargs or {})]
+            return Dataset._from_plan(
+                plan, f"{self._name}->map_batches(actors)")
         if isinstance(fn, type) or fn_constructor_args:
             # Fused stages call fn(batch); a callable CLASS would be
             # constructed per batch WITH the batch as its ctor arg.
@@ -109,10 +191,88 @@ class Dataset:
         return self._with_stage(stage, "filter")
 
     # ------------------------------------------------------------------
-    # execution
+    # execution: plan -> operator topology -> streaming executor
     # ------------------------------------------------------------------
-    def iter_block_refs(self, window: int = 2) -> Iterator[Any]:
-        return execute_streaming(self._sources, self._stages, window=window)
+    def _build_states(self):
+        from ray_tpu.data.operators import (ActorPoolMapOperator,
+                                            AllToAllOperator,
+                                            ConcatOperator, MapTaskOperator,
+                                            SourceOperator)
+        from ray_tpu.data.streaming_executor import OpState
+
+        import cloudpickle
+
+        states: List[OpState] = []
+
+        def wire(up: OpState, down: OpState) -> None:
+            up.downstream = (down, None)
+            down.upstreams.append(up)
+
+        def build_chain(nodes: List[Any]) -> OpState:
+            head = nodes[0]
+            idx = 1
+            if isinstance(head, _Read):
+                wire_items = [
+                    s if isinstance(s, ray_tpu.ObjectRef)
+                    else cloudpickle.dumps(s)
+                    for s in head.sources]
+                last = OpState(SourceOperator(wire_items))
+                states.append(last)
+                needs_task = any(not isinstance(s, ray_tpu.ObjectRef)
+                                 for s in head.sources)
+                if idx < len(nodes) and isinstance(nodes[idx], _Fused):
+                    # The fusion payoff: read + every chained transform
+                    # in ONE streaming task per source block.
+                    mo = OpState(MapTaskOperator(nodes[idx].stages,
+                                                 name="read->map"))
+                    wire(last, mo)
+                    states.append(mo)
+                    last = mo
+                    idx += 1
+                elif needs_task:
+                    mo = OpState(MapTaskOperator([], name="read"))
+                    wire(last, mo)
+                    states.append(mo)
+                    last = mo
+            elif isinstance(head, _UnionNode):
+                cs = OpState(ConcatOperator(len(head.parts)))
+                for bi, part in enumerate(head.parts):
+                    sink = build_chain(part)
+                    sink.downstream = (cs, bi)
+                    cs.upstreams.append(sink)
+                states.append(cs)
+                last = cs
+            else:
+                raise AssertionError(f"bad plan head {head!r}")
+
+            while idx < len(nodes):
+                node = nodes[idx]
+                if isinstance(node, _Fused):
+                    op = MapTaskOperator(node.stages, name="map")
+                elif isinstance(node, _ActorMapNode):
+                    op = ActorPoolMapOperator(
+                        node.fn, node.ctor_args, node.fn_kwargs,
+                        node.batch_size, node.batch_format,
+                        node.concurrency)
+                elif isinstance(node, _ExchangeNode):
+                    op = AllToAllOperator(node.fn, name=node.name)
+                else:
+                    raise AssertionError(f"bad plan node {node!r}")
+                st = OpState(op)
+                wire(last, st)
+                states.append(st)
+                last = st
+                idx += 1
+            return last
+
+        build_chain(self._plan)
+        return states
+
+    def iter_block_refs(self, window: Optional[int] = None) -> Iterator[Any]:
+        from ray_tpu.data.streaming_executor import (DEFAULT_TASK_BUDGET,
+                                                     execute_topology)
+        budget = DEFAULT_TASK_BUDGET if window is None else max(1, window)
+        return execute_topology(self._build_states(), task_budget=budget)
 
     def materialize(self) -> "Dataset":
         """Execute now; the result holds block refs (reference:
@@ -171,29 +331,43 @@ class Dataset:
         return None
 
     def num_blocks(self) -> int:
-        return len(self._sources)
+        n = 0
+        for node in self._plan:
+            if isinstance(node, _Read):
+                n = len(node.sources)
+            elif isinstance(node, _UnionNode):
+                n = sum(Dataset._from_plan(p, "part").num_blocks()
+                        for p in node.parts)
+            elif isinstance(node, _ExchangeNode) and \
+                    node.num_blocks_hint is not None:
+                n = node.num_blocks_hint
+        return n
 
     # ------------------------------------------------------------------
-    # reorganization
+    # reorganization (lazy all-to-all exchanges)
     # ------------------------------------------------------------------
     def repartition(self, num_blocks: int) -> "Dataset":
-        """Materialize then rebalance rows into num_blocks blocks."""
-        mat = self.materialize()
+        """Rebalance rows into num_blocks blocks (lazy barrier)."""
 
         @ray_tpu.remote(num_returns="streaming")
         def _rechunk(refs, n):
+            # refs ride inside a list arg so they arrive as refs (borrow-
+            # accounted), not pre-resolved values.
             whole = concat_blocks([ray_tpu.get(r) for r in refs])
             yield from _emit_chunks(BlockAccessor(whole), n)
 
-        refs = [r for r in _rechunk.remote(mat._sources, num_blocks)]
-        return Dataset(refs, [], name=f"{self._name}(repartition)")
+        def exchange(refs: List[Any]) -> List[Any]:
+            return list(_rechunk.remote(list(refs), num_blocks))
+
+        return self._with_exchange(exchange, "repartition",
+                                   num_blocks_hint=num_blocks)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Global shuffle: materialize + permute (single-task; fine at the
-        block counts this framework targets per host — the reference's
-        distributed shuffle service is multi-TB scale)."""
+        """Global shuffle: permute all rows (lazy barrier; single-task
+        permutation — fine at the block counts this framework targets per
+        host; the reference's distributed shuffle service is multi-TB
+        scale)."""
         n_blocks = max(1, self.num_blocks())
-        mat = self.materialize()
 
         @ray_tpu.remote(num_returns="streaming")
         def _shuffle(refs, n, seed):
@@ -209,8 +383,11 @@ class Dataset:
                 shuffled = [rows[i] for i in perm]
             yield from _emit_chunks(BlockAccessor(shuffled), n)
 
-        refs = [r for r in _shuffle.remote(mat._sources, n_blocks, seed)]
-        return Dataset(refs, [], name=f"{self._name}(shuffled)")
+        def exchange(refs: List[Any]) -> List[Any]:
+            return list(_shuffle.remote(list(refs), n_blocks, seed))
+
+        return self._with_exchange(exchange, "random_shuffle",
+                                   num_blocks_hint=n_blocks)
 
     def groupby(self, key: str, *,
                 num_partitions: Optional[int] = None):
@@ -236,17 +413,17 @@ class Dataset:
 
     def union(self, *others: "Dataset") -> "Dataset":
         """Concatenate datasets (reference: dataset.py union). Blocks of
-        each input stream in order (materialization-free); transforms
-        chained after the union apply to every part."""
-        return _UnionDataset([self, *others])
+        each input stream in order through a concat operator; transforms
+        chained after the union apply to the concatenated stream."""
+        parts = [list(self._plan)] + [list(o._plan) for o in others]
+        return Dataset._from_plan([_UnionNode(parts)], name="union")
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
         """Global sort by a column (reference: dataset.py sort), STABLE
-        in both directions. Materialize + single-task sort + re-chunk —
-        fine at per-host block counts (the reference's distributed
+        in both directions (lazy barrier; single-task sort — fine at
+        per-host block counts; the reference's distributed
         range-partition sort is multi-TB scale)."""
         n_blocks = max(1, self.num_blocks())
-        mat = self.materialize()
 
         @ray_tpu.remote(num_returns="streaming")
         def _sorted(refs, n, key, descending):
@@ -267,9 +444,12 @@ class Dataset:
                              key=lambda r: r[key], reverse=descending)
             yield from _emit_chunks(BlockAccessor(out), n)
 
-        refs = [r for r in _sorted.remote(mat._sources, n_blocks, key,
-                                          descending)]
-        return Dataset(refs, [], name=f"{self._name}(sorted)")
+        def exchange(refs: List[Any]) -> List[Any]:
+            return list(_sorted.remote(list(refs), n_blocks, key,
+                                       descending))
+
+        return self._with_exchange(exchange, "sort",
+                                   num_blocks_hint=n_blocks)
 
     def split(self, n: int) -> List["Dataset"]:
         """Materialize and split into n datasets by whole blocks
@@ -290,9 +470,15 @@ class Dataset:
         from ray_tpu.data.split import create_streaming_split
         return create_streaming_split(self, n, equal=equal)
 
+    def stats(self) -> Dict[str, Any]:
+        """Executed-operator metrics of the LAST full execution are not
+        retained (pull-driven executions are per-iterator); use
+        iter_block_refs on a StreamingExecutor directly for live metrics."""
+        return {"plan": [type(n).__name__ for n in self._plan]}
+
     def __repr__(self):
         return (f"Dataset(name={self._name!r}, "
-                f"blocks={len(self._sources)}, stages={len(self._stages)})")
+                f"plan={[type(n).__name__ for n in self._plan]})")
 
 
 def _emit_chunks(acc: "BlockAccessor", n: int):
@@ -304,27 +490,6 @@ def _emit_chunks(acc: "BlockAccessor", n: int):
     per = max(1, (total + n - 1) // n)
     for lo in _py_range(0, total, per):
         yield acc.slice(lo, min(total, lo + per))
-
-
-class _UnionDataset(Dataset):
-    """Concatenation of several datasets; chained transforms push down
-    into every part (Dataset._with_stage would rebuild from the empty
-    source list and silently drop everything)."""
-
-    def __init__(self, parts: List["Dataset"]):
-        super().__init__([], [], name="union")
-        self._parts = parts
-
-    def _with_stage(self, stage, name: str) -> "Dataset":
-        return _UnionDataset([p._with_stage(stage, name)
-                              for p in self._parts])
-
-    def num_blocks(self) -> int:
-        return sum(p.num_blocks() for p in self._parts)
-
-    def iter_block_refs(self, window: int = 2):
-        for p in self._parts:
-            yield from p.iter_block_refs(window=window)
 
 
 def _map_block_batches(block, call, batch_size, batch_format, kwargs):
@@ -361,103 +526,6 @@ class _MapActor:
                                        self._batch_size,
                                        self._batch_format, self._kwargs))
         return concat_blocks(outs) if len(outs) != 1 else outs[0]
-
-
-class _ActorMapDataset(Dataset):
-    """A Dataset whose next stage runs on an actor pool; further
-    transforms chain as fused per-block streaming tasks downstream."""
-
-    def __init__(self, upstream: Dataset, fn, batch_size, batch_format,
-                 concurrency: int, ctor_args: tuple, fn_kwargs: dict,
-                 stages: Optional[List] = None):
-        super().__init__([], stages,
-                         name=f"{upstream._name}->map_batches(actors)")
-        self._upstream = upstream
-        self._fn = fn
-        self._batch_size = batch_size
-        self._batch_format = batch_format
-        self._concurrency = concurrency
-        self._ctor_args = ctor_args
-        self._fn_kwargs = fn_kwargs
-
-    def _with_stage(self, stage, name: str) -> "Dataset":
-        return _ActorMapDataset(self._upstream, self._fn,
-                                self._batch_size, self._batch_format,
-                                self._concurrency, self._ctor_args,
-                                self._fn_kwargs,
-                                self._stages + [stage])
-
-    def num_blocks(self) -> int:
-        return self._upstream.num_blocks()
-
-    def iter_block_refs(self, window: int = 2) -> Iterator[Any]:
-        from collections import deque
-
-        import cloudpickle
-
-        import ray_tpu
-
-        actor_cls = ray_tpu.remote(_MapActor)
-        actors = [actor_cls.remote(
-            cloudpickle.dumps(self._fn), cloudpickle.dumps(self._ctor_args),
-            self._batch_size, self._batch_format,
-            cloudpickle.dumps(self._fn_kwargs))
-            for _ in _py_range(self._concurrency)]
-        cap = 2 * self._concurrency
-
-        def actor_refs():
-            recent: deque = deque(maxlen=cap)
-            exhausted = False
-            try:
-                inflight: deque = deque()
-                rr = 0
-                for ref in self._upstream.iter_block_refs(window=window):
-                    if len(inflight) >= cap:  # upstream backpressure
-                        head = inflight.popleft()
-                        ray_tpu.wait([head], num_returns=1)
-                        yield head
-                    out = actors[rr % len(actors)].apply.remote(ref)
-                    rr += 1
-                    inflight.append(out)
-                    recent.append(out)
-                while inflight:
-                    yield inflight.popleft()
-                exhausted = True
-            finally:
-                # Normal exhaustion: wait for yielded-but-unfetched
-                # results to finish materializing (consumers prefetch
-                # refs) — no arbitrary cutoff killing slow transforms.
-                # Early abandonment (take(k), closed generator): the
-                # consumer won't fetch anything more; kill immediately.
-                if exhausted and recent:
-                    try:
-                        ray_tpu.wait(list(recent),
-                                     num_returns=len(recent))
-                    except Exception:
-                        pass
-                for a in actors:
-                    try:
-                        ray_tpu.kill(a)
-                    except Exception:
-                        pass
-
-        refs = actor_refs()
-        if not self._stages:
-            yield from refs
-            return
-        # Chained transforms run as fused per-block streaming tasks.
-        from collections import deque
-
-        from ray_tpu.data.executor import _source_task_fn
-        stages_blob = cloudpickle.dumps(self._stages)
-        remote_fn = ray_tpu.remote(num_returns="streaming")(_source_task_fn)
-        pending: deque = deque()
-        for ref in refs:
-            pending.append(remote_fn.remote(ref, stages_blob))
-            while len(pending) > window:
-                yield from pending.popleft()
-        while pending:
-            yield from pending.popleft()
 
 
 class DataIterator:

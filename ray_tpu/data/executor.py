@@ -1,38 +1,17 @@
-"""Streaming executor: drives per-source block pipelines through the task
-runtime as STREAMING GENERATOR tasks with bounded in-flight work.
+"""Fused map task bodies for the Data streaming executor.
 
-Analogue of the reference's streaming execution (reference:
-python/ray/data/_internal/execution/streaming_executor.py:61 executor loop,
-streaming_executor_state.py select_operator_to_run/process_completed_tasks,
-operators/map_operator.py tasks returning ObjectRefGenerators of blocks,
-logical/optimizers.py operator fusion). Redesigned for the linear plans this
-framework supports:
-
-  * ALL map-like stages FUSE into the read/source task — one streaming
-    remote task per source yields transformed blocks as they are produced
-    (the reference's MapOperator fusion rule taken to its limit).
-  * Backpressure is the generator backpressure built into the runtime: a
-    producer task stalls once `streaming_generator_backpressure_items`
-    yielded blocks sit unconsumed, so the executor needs no resource
-    manager of its own for the linear case.
-  * The executor keeps `window` source tasks active and yields block refs
-    in source order — downstream consumption (a TPU train step) overlaps
-    with upstream reads and transforms.
+The planner (dataset.py _build_states) fuses every chain of row/batch
+transforms into ONE streaming task per source block (the reference's
+MapOperator fusion rule — reference:
+python/ray/data/_internal/logical/rules/operator_fusion.py — taken to its
+limit); this module holds the task-side machinery those fused tasks run.
+The executor loop, operators, and backpressure live in
+streaming_executor.py / operators.py.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterator, List, Optional, Tuple
-
-import ray_tpu
-from ray_tpu.utils import get_logger
-
-logger = get_logger("data.executor")
-
-# Number of source tasks kept in flight (reference analogue:
-# resource_manager.py ReservationOpResourceAllocator, collapsed to a window;
-# per-task block backpressure bounds memory within each).
-DEFAULT_WINDOW = 2
+from typing import Any, Callable, Iterator, List
 
 # A stage maps one block to zero or more output blocks.
 Stage = Callable[[Any], Iterator[Any]]
@@ -65,53 +44,3 @@ def _source_task_fn(source, stages_blob: bytes):
         blocks = iter([source])  # already-resolved materialized block
     for block in blocks:
         yield from apply_stages(block, stages)
-
-
-def execute_streaming(sources: List[Any], stages: List[Stage],
-                      window: int = DEFAULT_WINDOW,
-                      resources: Optional[dict] = None) -> Iterator[Any]:
-    """Yield output block refs in source order.
-
-    `sources` entries are either ObjectRefs of materialized blocks or
-    zero-arg callables yielding blocks (read tasks). With no stages,
-    materialized refs pass through without spawning tasks.
-    """
-    import cloudpickle
-
-    if not stages and all(isinstance(s, ray_tpu.ObjectRef) for s in sources):
-        yield from sources
-        return
-
-    stages_blob = cloudpickle.dumps(stages)
-
-    remote_fn = ray_tpu.remote(num_returns="streaming")(_source_task_fn)
-    if resources:
-        remote_fn = remote_fn.options(resources=resources)
-
-    def _wire_source(s):
-        return s if isinstance(s, ray_tpu.ObjectRef) else \
-            cloudpickle.dumps(s)
-
-    window = max(1, window)
-    gens: List[Any] = []
-    idx = 0
-    # Prime the window, then drain generators in order, topping up as
-    # sources complete. Each active generator produces autonomously into
-    # its backpressure window.
-    while idx < len(sources) and len(gens) < window:
-        gens.append(remote_fn.remote(_wire_source(sources[idx]),
-                                     stages_blob))
-        idx += 1
-    while gens:
-        head = gens.pop(0)
-        for ref in head:
-            yield ref
-        if idx < len(sources) and len(gens) < window:
-            gens.append(remote_fn.remote(_wire_source(sources[idx]),
-                                         stages_blob))
-            idx += 1
-
-
-def execute_to_blocks(sources: List[Any], stages: List[Stage],
-                      window: int = DEFAULT_WINDOW) -> List[Any]:
-    return list(execute_streaming(sources, stages, window))

@@ -1,0 +1,252 @@
+"""Streaming executor: drives an operator-graph topology with per-op
+budgets and backpressure.
+
+Analogue of the reference's streaming execution core (reference:
+python/ray/data/_internal/execution/streaming_executor.py:61 executor loop,
+streaming_executor_state.py build_streaming_topology/select_operator_to_run/
+process_completed_tasks, resource_manager.py:40 ResourceManager +
+:363 ReservationOpResourceAllocator, backpressure_policy/
+concurrency_cap_backpressure_policy.py). Redesigned pull-driven:
+
+  * The CONSUMER drives the loop — each `next()` harvests completions,
+    moves blocks downstream, and dispatches new work until an output
+    block is available. No executor thread: when the consumer stalls,
+    dispatch stops, in-flight generator tasks park on the runtime's
+    per-task yield backpressure, and total in-flight memory stays at
+    (per-op task budget x per-task window) blocks. A slow consumer
+    therefore stalls the producers (the reference needs a thread +
+    output-queue cap for the same property; here it falls out of the
+    pull design).
+  * Operator selection prefers the op CLOSEST TO THE SINK that can run
+    (same drain-downstream-first policy as select_operator_to_run:
+    finishing blocks frees memory before new blocks are created).
+  * The ResourceManager splits a global in-flight task budget equally
+    across task-launching ops (reservation), and lends unused slots to
+    ops with queued work (the reservation allocator's shared pool).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from ray_tpu.data.operators import (AllToAllOperator, ConcatOperator,
+                                    PhysicalOperator, SourceOperator)
+from ray_tpu.utils import get_logger
+
+logger = get_logger("data.streaming_executor")
+
+# Global in-flight task budget split across task-launching operators
+# (reference: ReservationOpResourceAllocator's reservation ratio over the
+# cluster resource budget, collapsed to task slots — block memory follows
+# task count here because every task's output window is bounded by the
+# runtime's generator backpressure).
+DEFAULT_TASK_BUDGET = 8
+
+# Per-edge queue cap: an op stops dispatching when this many of its output
+# blocks sit undispatched in the downstream op's input queue (reference:
+# OutputQueueSizeBackpressurePolicy).
+DEFAULT_EDGE_QUEUE_CAP = 16
+
+
+class OpState:
+    """Executor-side wiring for one operator."""
+
+    def __init__(self, op: PhysicalOperator):
+        self.op = op
+        # (downstream OpState, branch index for ConcatOperator or None)
+        self.downstream: Optional[Tuple["OpState", Optional[int]]] = None
+        self.upstreams: List["OpState"] = []
+        self.done_notified = False
+
+    @property
+    def name(self) -> str:
+        return self.op.name
+
+
+class ResourceManager:
+    """Task-slot budgeting + queue backpressure across operators
+    (reference: resource_manager.py ReservationOpResourceAllocator +
+    backpressure policies). Each task-launching op holds a reserved share
+    of the global budget; the remainder is a shared pool any op may
+    borrow from. An op's output edge blocks when the downstream input
+    queue exceeds the edge cap."""
+
+    def __init__(self, ops: List[OpState], budget: int = DEFAULT_TASK_BUDGET,
+                 edge_queue_cap: int = DEFAULT_EDGE_QUEUE_CAP):
+        self.budget = max(1, budget)
+        self.edge_queue_cap = edge_queue_cap
+        launchers = [s for s in ops
+                     if not isinstance(s.op, (SourceOperator, ConcatOperator))]
+        self._reserved = max(1, self.budget // max(1, len(launchers)))
+
+    def can_launch(self, state: OpState, total_active: int) -> bool:
+        op = state.op
+        if isinstance(op, AllToAllOperator):
+            return True  # barrier op: runs once, driver-side
+        if op.num_active_tasks() < self._reserved:
+            return True  # within reserved share
+        return total_active < self.budget  # borrow from the shared pool
+
+    def output_blocked(self, state: OpState, sink_queue_len: int) -> bool:
+        down = state.downstream
+        if down is None:
+            # Sink edge: bounded by the executor's output buffer (the
+            # pull-driven consumer usually keeps this at ~0).
+            return sink_queue_len >= self.edge_queue_cap
+        target, branch = down
+        if branch is not None and isinstance(target.op, ConcatOperator):
+            queued = len(target.op._branch_queues[branch])
+        else:
+            queued = target.op.num_queued_inputs()
+        return queued >= self.edge_queue_cap
+
+
+class StreamingExecutor:
+    """Executes a topology (list of OpStates in topological order, the
+    last being the sink) as a pull-driven block-ref iterator."""
+
+    def __init__(self, states: List[OpState],
+                 task_budget: int = DEFAULT_TASK_BUDGET,
+                 edge_queue_cap: int = DEFAULT_EDGE_QUEUE_CAP):
+        self._states = states
+        self._sink = states[-1]
+        assert self._sink.downstream is None
+        self._rm = ResourceManager(states, task_budget, edge_queue_cap)
+        self._out_queue: List[Any] = []
+        self._started = False
+        self._shut = False
+
+    # -- public ---------------------------------------------------------
+    def run(self) -> Iterator[Any]:
+        try:
+            while True:
+                ref = self._next_output()
+                if ref is _DONE:
+                    return
+                yield ref
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        if self._shut:
+            return
+        self._shut = True
+        for s in self._states:
+            try:
+                s.op.shutdown()
+            except Exception:
+                logger.debug("shutdown of %s failed", s.name, exc_info=True)
+
+    def metrics(self) -> Dict[str, Any]:
+        return {s.name: s.op.metrics for s in self._states}
+
+    # -- internals ------------------------------------------------------
+    def _next_output(self):
+        if not self._started:
+            self._started = True
+            for s in self._states:
+                s.op.start()
+        while True:
+            if self._out_queue:
+                return self._out_queue.pop(0)
+            progressed = self._step()
+            if self._out_queue:
+                return self._out_queue.pop(0)
+            if self._all_done():
+                return _DONE
+            if not progressed:
+                self._wait_for_progress()
+
+    def _step(self) -> bool:
+        """One scheduling pass: harvest + route + dispatch. Returns True
+        if anything moved."""
+        progressed = False
+
+        # 1. Harvest completions and route blocks downstream, sink-first
+        #    (freeing downstream capacity before upstream produces more).
+        for s in reversed(self._states):
+            outs = s.op.poll()
+            if outs:
+                progressed = True
+                for ref in outs:
+                    self._route(s, ref)
+            # Propagate upstream-exhaustion exactly once.
+            if s.op.completed() and not s.done_notified:
+                s.done_notified = True
+                progressed = True
+                self._notify_done(s)
+
+        # 2. Dispatch: pick ops that can run, closest-to-sink first.
+        total_active = sum(s.op.num_active_tasks() for s in self._states)
+        for s in reversed(self._states):
+            while (s.op.can_dispatch()
+                   and self._rm.can_launch(s, total_active)
+                   and not self._rm.output_blocked(s, len(self._out_queue))):
+                if not s.op.dispatch():
+                    break
+                total_active += 1
+                progressed = True
+        return progressed
+
+    def _route(self, s: OpState, ref: Any) -> None:
+        down = s.downstream
+        if down is None:
+            self._out_queue.append(ref)
+            return
+        target, branch = down
+        if branch is not None:
+            assert isinstance(target.op, ConcatOperator)
+            target.op.add_branch_input(branch, ref)
+        else:
+            target.op.add_input(ref)
+
+    def _notify_done(self, s: OpState) -> None:
+        down = s.downstream
+        if down is None:
+            return
+        target, branch = down
+        if branch is not None:
+            assert isinstance(target.op, ConcatOperator)
+            target.op.branch_done(branch)
+        else:
+            # Multi-upstream non-concat target: done only when ALL
+            # upstreams are done.
+            if all(u.done_notified for u in target.upstreams):
+                target.op.all_inputs_done()
+
+    def _all_done(self) -> bool:
+        return not self._out_queue \
+            and all(s.op.completed() for s in self._states)
+
+    def _wait_for_progress(self, timeout: float = 0.05) -> None:
+        """Nothing moved and nothing ready: park on the busiest op."""
+        for s in reversed(self._states):
+            if s.op.num_active_tasks():
+                s.op.wait_any(timeout)
+                return
+        import time
+        time.sleep(0.005)
+
+
+class _Done:
+    pass
+
+
+_DONE = _Done()
+
+
+def build_linear_topology(ops: List[PhysicalOperator]) -> List[OpState]:
+    """Wire a simple chain: ops[0] -> ops[1] -> ... -> ops[-1]."""
+    states = [OpState(op) for op in ops]
+    for up, down in zip(states, states[1:]):
+        up.downstream = (down, None)
+        down.upstreams.append(up)
+    return states
+
+
+def execute_topology(states: List[OpState],
+                     task_budget: int = DEFAULT_TASK_BUDGET,
+                     edge_queue_cap: int = DEFAULT_EDGE_QUEUE_CAP
+                     ) -> Iterator[Any]:
+    ex = StreamingExecutor(states, task_budget, edge_queue_cap)
+    return ex.run()

@@ -132,7 +132,6 @@ def save_checkpoint(directory: str, state: Any, step: int,
     if proc == 0:
         _recover_trashed(directory, step)
         if os.path.isdir(ckpt_dir):
-            import shutil
             shutil.rmtree(ckpt_dir, ignore_errors=True)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
@@ -184,7 +183,6 @@ def save_checkpoint(directory: str, state: Any, step: int,
         # A crash before this point leaves the previous committed
         # checkpoint untouched; the rename pair's window is microseconds
         # (vs. the whole shard-write window if we cleared in place).
-        import shutil
         trash = os.path.join(directory, f"_trash-step-{step}")
         shutil.rmtree(trash, ignore_errors=True)
         if os.path.isdir(final_dir):
