@@ -27,6 +27,7 @@ concurrency_cap_backpressure_policy.py). Redesigned pull-driven:
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from ray_tpu.data.operators import (AllToAllOperator, ConcatOperator,
@@ -75,17 +76,27 @@ class ResourceManager:
                  edge_queue_cap: int = DEFAULT_EDGE_QUEUE_CAP):
         self.budget = max(1, budget)
         self.edge_queue_cap = edge_queue_cap
-        launchers = [s for s in ops
-                     if not isinstance(s.op, (SourceOperator, ConcatOperator))]
-        self._reserved = max(1, self.budget // max(1, len(launchers)))
+        # Barrier (AllToAll) ops run driver-side outside the slot budget,
+        # so they neither reserve nor consume shares.
+        self._launchers = [
+            s for s in ops
+            if not isinstance(s.op, (SourceOperator, ConcatOperator,
+                                     AllToAllOperator))]
+        n = max(1, len(self._launchers))
+        self._reserved = max(1, self.budget // n)
+        self._shared_pool = max(0, self.budget - self._reserved * n)
 
-    def can_launch(self, state: OpState, total_active: int) -> bool:
+    def can_launch(self, state: OpState) -> bool:
         op = state.op
         if isinstance(op, AllToAllOperator):
             return True  # barrier op: runs once, driver-side
+        actives = [s.op.num_active_tasks() for s in self._launchers]
+        if sum(actives) >= self.budget:
+            return False  # absolute cap — borrows never exceed the budget
         if op.num_active_tasks() < self._reserved:
             return True  # within reserved share
-        return total_active < self.budget  # borrow from the shared pool
+        shared_used = sum(max(0, a - self._reserved) for a in actives)
+        return shared_used < self._shared_pool
 
     def output_blocked(self, state: OpState, sink_queue_len: int) -> bool:
         down = state.downstream
@@ -112,7 +123,7 @@ class StreamingExecutor:
         self._sink = states[-1]
         assert self._sink.downstream is None
         self._rm = ResourceManager(states, task_budget, edge_queue_cap)
-        self._out_queue: List[Any] = []
+        self._out_queue: deque = deque()
         self._started = False
         self._shut = False
 
@@ -148,10 +159,10 @@ class StreamingExecutor:
                 s.op.start()
         while True:
             if self._out_queue:
-                return self._out_queue.pop(0)
+                return self._out_queue.popleft()
             progressed = self._step()
             if self._out_queue:
-                return self._out_queue.pop(0)
+                return self._out_queue.popleft()
             if self._all_done():
                 return _DONE
             if not progressed:
@@ -177,14 +188,12 @@ class StreamingExecutor:
                 self._notify_done(s)
 
         # 2. Dispatch: pick ops that can run, closest-to-sink first.
-        total_active = sum(s.op.num_active_tasks() for s in self._states)
         for s in reversed(self._states):
             while (s.op.can_dispatch()
-                   and self._rm.can_launch(s, total_active)
+                   and self._rm.can_launch(s)
                    and not self._rm.output_blocked(s, len(self._out_queue))):
                 if not s.op.dispatch():
                     break
-                total_active += 1
                 progressed = True
         return progressed
 
@@ -233,15 +242,6 @@ class _Done:
 
 
 _DONE = _Done()
-
-
-def build_linear_topology(ops: List[PhysicalOperator]) -> List[OpState]:
-    """Wire a simple chain: ops[0] -> ops[1] -> ... -> ops[-1]."""
-    states = [OpState(op) for op in ops]
-    for up, down in zip(states, states[1:]):
-        up.downstream = (down, None)
-        down.upstreams.append(up)
-    return states
 
 
 def execute_topology(states: List[OpState],

@@ -29,19 +29,27 @@ from typing import Any, Optional
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.ref import ObjectRef, get_core_worker
 
+# (owner slice, local slice) pairs whose host-relay routing was logged.
+_cross_slice_logged: set = set()
+
 
 class DeviceRef:
     """Handle to an array resident on its owner process's devices.
 
     Wraps an ObjectRef (`.ref`) so reference counting, borrows, and
-    owner-death cleanup work exactly like host objects."""
+    owner-death cleanup work exactly like host objects. Carries the
+    owner's SLICE identity so readers can route: same slice -> ICI/DMA
+    transfer plane; different slice -> host relay over the object plane
+    (DCN) unless cross_slice_device_dma says the plane spans slices."""
 
-    __slots__ = ("ref", "shape", "dtype")
+    __slots__ = ("ref", "shape", "dtype", "slice")
 
-    def __init__(self, ref: ObjectRef, shape, dtype: str):
+    def __init__(self, ref: ObjectRef, shape, dtype: str,
+                 slice: str = ""):  # noqa: A002
         self.ref = ref
         self.shape = tuple(shape)
         self.dtype = dtype
+        self.slice = slice
 
     @property
     def owner_addr(self):
@@ -54,11 +62,12 @@ class DeviceRef:
     def __reduce__(self):
         # Pickling recurses into self.ref -> ObjectRef.__reduce__ ->
         # note_contained_ref: borrower accounting comes for free.
-        return (DeviceRef, (self.ref, self.shape, self.dtype))
+        return (DeviceRef, (self.ref, self.shape, self.dtype, self.slice))
 
     def __repr__(self):
         return (f"DeviceRef({self.ref.hex()[:12]}, shape={self.shape}, "
-                f"dtype={self.dtype}, owner={self.owner_addr})")
+                f"dtype={self.dtype}, owner={self.owner_addr}, "
+                f"slice={self.slice!r})")
 
 
 def device_put_ref(array: Any) -> DeviceRef:
@@ -77,8 +86,10 @@ def device_put_ref(array: Any) -> DeviceRef:
     from ray_tpu.core import serialization
     sv = serialization.serialize({"__device_marker__": True})
     cw.put_inline_marker(oid.binary(), sv)
+    from ray_tpu.accelerators import slice_name
     return DeviceRef(ref, getattr(array, "shape", ()),
-                     str(getattr(array, "dtype", "float32")))
+                     str(getattr(array, "dtype", "float32")),
+                     slice=slice_name())
 
 
 def device_get(ref: DeviceRef, *, sharding: Optional[Any] = None,
@@ -98,13 +109,37 @@ def device_get(ref: DeviceRef, *, sharding: Optional[Any] = None,
             return jax.device_put(local, sharding)
         return local
     client = cw._client_for_worker(tuple(ref.owner_addr))
-    try:
-        info = cw._run(client.call("device_pull_info", key,
-                                   wait_s=0.0)).result(timeout)
-    except Exception:
-        # Owner can't stage (e.g. no transfer plane on its backend):
-        # the host-bytes endpoint below still works.
+    # Slice-aware routing (SURVEY §5.8 two-plane mapping): the transfer
+    # plane is an ICI/DMA-domain transport — across slice boundaries it
+    # only applies when the deployment says the plane spans slices
+    # (cross_slice_device_dma); otherwise relay device->host->DCN->device
+    # through the ordinary object-plane RPC. Decided BEFORE
+    # device_pull_info so no ticket is staged (staging pins the array).
+    from ray_tpu.accelerators import slice_name
+    from ray_tpu.utils.config import GlobalConfig
+    cross_slice = getattr(ref, "slice", "") != slice_name()
+    if cross_slice and not GlobalConfig.cross_slice_device_dma:
+        # Once per (owner slice, local slice) pair: an env asymmetry in
+        # TPU_NAME would silently demote SAME-slice pulls to host-relay
+        # speed forever — make the routing decision observable.
+        pair = (getattr(ref, "slice", ""), slice_name())
+        if pair not in _cross_slice_logged:
+            _cross_slice_logged.add(pair)
+            from ray_tpu.utils import get_logger
+            get_logger("device_objects").info(
+                "cross-slice device_get (owner slice %r, local slice %r): "
+                "host-relaying over the object plane; set "
+                "cross_slice_device_dma=true if the transfer plane spans "
+                "these slices", pair[0], pair[1])
         info = None
+    else:
+        try:
+            info = cw._run(client.call("device_pull_info", key,
+                                       wait_s=0.0)).result(timeout)
+        except Exception:
+            # Owner can't stage (e.g. no transfer plane on its backend):
+            # the host-bytes endpoint below still works.
+            info = None
     if info is not None:
         from ray_tpu.experimental.device_plane import DevicePlane
         addr, uuid, descs = info

@@ -29,6 +29,11 @@ class ParallelContext:
         return ParallelContext(build_mesh(config, devices), config)
 
     @property
+    def num_slices(self) -> int:
+        """Slices this context's mesh spans (DCN axes; 1 = single slice)."""
+        return self.config.num_slices
+
+    @property
     def sp(self) -> int:
         return self.config.sp
 

@@ -45,6 +45,16 @@ class MeshConfig:
     ep: int = 1
     sp: int = 1
     tp: int = 1
+    # Cross-slice (DCN) factors: how much of pp/dp/fsdp spans SLICES
+    # rather than ICI (SURVEY §5.8; the scaling-book recipe: only the
+    # lowest-bandwidth axes — dp, fsdp-reduce, pp activations — may ride
+    # DCN; tp/sp/ep stay strictly intra-slice, enforced by construction
+    # since they have no DCN factor). The slice-crossing factor of each
+    # axis is OUTERMOST within that axis, so GSPMD's per-axis collectives
+    # decompose into intra-slice ICI ops + a small cross-slice phase.
+    dcn_pp: int = 1
+    dcn_dp: int = 1
+    dcn_fsdp: int = 1
 
     @property
     def shape(self) -> tuple[int, ...]:
@@ -53,6 +63,27 @@ class MeshConfig:
     @property
     def num_devices(self) -> int:
         return math.prod(self.shape)
+
+    @property
+    def num_slices(self) -> int:
+        return self.dcn_pp * self.dcn_dp * self.dcn_fsdp
+
+    @property
+    def dcn_shape(self) -> tuple[int, ...]:
+        return (self.dcn_pp, self.dcn_dp, self.dcn_fsdp, 1, 1, 1)
+
+    @property
+    def ici_shape(self) -> tuple[int, ...]:
+        """Per-slice factor of each axis."""
+        out = []
+        for name, total, dcn in zip(AXIS_NAMES, self.shape, self.dcn_shape):
+            if total % dcn:
+                raise ValueError(
+                    f"axis {name}={total} not divisible by its DCN factor "
+                    f"{dcn} (the slice-crossing factor must divide the "
+                    f"axis)")
+            out.append(total // dcn)
+        return tuple(out)
 
     def with_axes(self, **kw) -> "MeshConfig":
         return dataclasses.replace(self, **kw)
@@ -63,7 +94,38 @@ class MeshConfig:
         return MeshConfig(fsdp=n)
 
 
-def build_mesh(config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+def _slice_groups(devices: list, num_slices: int) -> list:
+    """Partition devices into per-slice groups. Real multi-slice TPUs
+    expose `device.slice_index`; virtual/CPU meshes fall back to
+    contiguous equal chunks (the driver's 2-virtual-slice dry run)."""
+    per = len(devices) // num_slices
+    if len(devices) % num_slices:
+        raise ValueError(f"{len(devices)} devices do not split into "
+                         f"{num_slices} equal slices")
+    by_slice: dict = {}
+    if getattr(devices[0], "slice_index", None) is not None:
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+    if by_slice:
+        # Real slice topology present: grouping must be exact. A silent
+        # contiguous fallback here would build "ICI" submeshes that
+        # straddle physical slice boundaries — a topology lie.
+        if len(by_slice) < num_slices or \
+                any(len(v) < per for v in sorted(
+                    by_slice.values(), key=len, reverse=True)[:num_slices]):
+            raise ValueError(
+                f"cannot form {num_slices} slices of {per} devices from "
+                f"physical slices "
+                f"{ {k: len(v) for k, v in by_slice.items()} } — pick DCN "
+                f"factors matching the real slice topology")
+        keys = sorted(by_slice)[:num_slices]
+        return [by_slice[k][:per] for k in keys]
+    # No slice identity (CPU / virtual mesh): contiguous equal chunks.
+    return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
+
+
+def build_mesh(config: MeshConfig,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     n = config.num_devices
@@ -71,12 +133,38 @@ def build_mesh(config: MeshConfig, devices: Optional[Sequence[jax.Device]] = Non
         raise ValueError(
             f"MeshConfig {config} needs {n} devices but only {len(devices)} available")
     devices = list(devices)[:n]
-    try:
-        dev_array = mesh_utils.create_device_mesh(
-            config.shape, devices=devices, allow_split_physical_axes=True)
-    except Exception:
-        dev_array = np.array(devices).reshape(config.shape)
-    return Mesh(dev_array, AXIS_NAMES)
+    if config.num_slices == 1:
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                config.shape, devices=devices, allow_split_physical_axes=True)
+        except Exception:
+            dev_array = np.array(devices).reshape(config.shape)
+        return Mesh(dev_array, AXIS_NAMES)
+
+    # Multi-slice (DCN) mesh: per-slice ICI submeshes composed so each
+    # axis's slice-crossing factor is OUTERMOST within the axis (the
+    # layout jax.experimental.mesh_utils.create_hybrid_device_mesh
+    # produces; built manually so virtual CPU slices — no slice_index —
+    # work identically for the multi-chip dry run).
+    ici_shape = config.ici_shape
+    dcn_shape = config.dcn_shape
+    groups = _slice_groups(devices, config.num_slices)
+    slice_arrays = []
+    for g in groups:
+        try:
+            a = mesh_utils.create_device_mesh(
+                ici_shape, devices=g, allow_split_physical_axes=True)
+        except Exception:
+            a = np.array(g).reshape(ici_shape)
+        slice_arrays.append(a)
+    arr = np.empty(dcn_shape + ici_shape, dtype=object)
+    for si, sa in enumerate(slice_arrays):
+        arr[np.unravel_index(si, dcn_shape)] = sa
+    # Interleave (dcn_0, ici_0, dcn_1, ici_1, ...) then merge each pair:
+    # axis k of the final mesh = dcn_k (outer) x ici_k (inner).
+    k = len(AXIS_NAMES)
+    arr = arr.transpose([ax for i in range(k) for ax in (i, k + i)])
+    return Mesh(arr.reshape(config.shape), AXIS_NAMES)
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:

@@ -102,6 +102,7 @@ _flag("testing_event_loop_delay_us", int, 0, "Inject delay into event-loop handl
 _flag("tpu_chips_per_host", int, 0, "Explicit chip count (0 = auto-detect).")
 _flag("tpu_visible_chips", str, "", "Analogue of TPU_VISIBLE_CHIPS pinning.")
 _flag("collective_cpu_fallback", bool, True, "Allow CPU fallback collectives when no TPU present.")
+_flag("cross_slice_device_dma", bool, False, "Let the PJRT transfer plane pull device objects ACROSS slice boundaries. Off (default): cross-slice device_get host-relays through the object plane (device->host->DCN RPC->device), the safe path when slices share no ICI/DMA domain.")
 
 # --- logging / observability ---
 _flag("log_to_driver", bool, True, "Stream worker stdout/stderr lines to the driver via the controller log_events channel. NOTE: the channel is cluster-global (no per-job scoping yet); multiple concurrent drivers see each other's worker output.")

@@ -104,6 +104,26 @@ def test_device_ref_local_roundtrip(cluster):
     assert np.allclose(np.asarray(out), 1.0)
 
 
+def test_cross_slice_device_get_host_relays(cluster):
+    """A DeviceRef owned on a DIFFERENT slice must route through the
+    host-relay (object-plane/DCN) path, not the intra-slice transfer
+    plane (SURVEY §5.8; cross_slice_device_dma defaults off)."""
+    other_env = {"env_vars": {"JAX_PLATFORMS": "cpu",
+                              "PALLAS_AXON_POOL_IPS": None,
+                              "TPU_NAME": "slice-B"}}
+    a = TensorActor.options(runtime_env=other_env).remote()
+    ref = ray_tpu.get(a.make_ref.remote(5.0))
+    assert ref.slice == "slice-B"
+    before = ray_tpu.get(a.plane_stats.remote())
+    arr = device_get(ref, timeout=60.0)
+    assert np.allclose(np.asarray(arr), np.arange(8.0) * 5.0)
+    # The owner must NOT have staged a transfer-plane ticket: the pull
+    # rode the host-bytes relay.
+    after = ray_tpu.get(a.plane_stats.remote())
+    assert after["staged"] == before["staged"], \
+        "cross-slice device_get used the intra-slice transfer plane"
+
+
 # ----------------------------------------------------------------------
 # Device channels: acquire/release + backpressure
 # ----------------------------------------------------------------------
