@@ -21,6 +21,7 @@ import time
 import urllib.request
 
 QUICK = "--quick" in sys.argv
+TTFT_ONLY = "--ttft-only" in sys.argv  # solo TTFT + decode rate, no sweep
 
 
 def emit(metric: str, value: float, unit: str) -> None:
@@ -94,6 +95,8 @@ def main() -> None:
         if rates:
             emit("serve_llama_decode_tokens_per_s",
                  sum(rates) / len(rates), "tokens/s")
+        if TTFT_ONLY:
+            return
 
         # ------------------------------------------------------------------
         # Concurrency sweep: aggregate tokens/s + p50 TTFT per level.

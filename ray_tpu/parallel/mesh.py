@@ -109,17 +109,18 @@ def _slice_groups(devices: list, num_slices: int) -> list:
     if by_slice:
         # Real slice topology present: grouping must be exact. A silent
         # contiguous fallback here would build "ICI" submeshes that
-        # straddle physical slice boundaries — a topology lie.
-        if len(by_slice) < num_slices or \
-                any(len(v) < per for v in sorted(
-                    by_slice.values(), key=len, reverse=True)[:num_slices]):
+        # straddle physical slice boundaries — a topology lie. Use the
+        # first num_slices slices (by index) that actually have enough
+        # devices, so one undersized slice can't poison the selection.
+        eligible = [k for k in sorted(by_slice)
+                    if len(by_slice[k]) >= per]
+        if len(eligible) < num_slices:
             raise ValueError(
                 f"cannot form {num_slices} slices of {per} devices from "
                 f"physical slices "
                 f"{ {k: len(v) for k, v in by_slice.items()} } — pick DCN "
                 f"factors matching the real slice topology")
-        keys = sorted(by_slice)[:num_slices]
-        return [by_slice[k][:per] for k in keys]
+        return [by_slice[k][:per] for k in eligible[:num_slices]]
     # No slice identity (CPU / virtual mesh): contiguous equal chunks.
     return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
 

@@ -16,8 +16,10 @@ engine_kwargs feed vLLM's continuous batcher; here the engine is OURS):
   (`lax.fori_loop` on device), the same latency/throughput dial the
   single-stream path used.
 
-Exactly two compiled programs serve all traffic: prefill (padded to
-max_seq) and the n-step decode chunk over all slots.
+A small fixed set of compiled programs serves all traffic: one prefill
+per power-of-2 BUCKET width (a short prompt pays a short prefill — the
+TTFT lever; smallest and largest warmed at startup, others on first use)
+and the n-step decode chunk over all slots.
 """
 
 from __future__ import annotations
@@ -77,10 +79,12 @@ def _build_fns(mcfg, n_slots: int, chunk: int):
                                v[0].transpose(1, 0, 2))
 
     def prefill(params, kc, vc, slot, tokens, length):
-        """tokens [1, S] padded; writes slot's k/v, returns the first
-        generated token (greedy)."""
+        """tokens [1, B] padded to a BUCKET width (powers of 2 up to
+        max_seq — jax.jit compiles one program per bucket shape, so a
+        short prompt pays a short prefill, not a max_seq one); writes
+        slot's k/v, returns the first generated token (greedy)."""
         x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
-        cos, sin = rope_frequencies(hd, S, mcfg.rope_theta)
+        cos, sin = rope_frequencies(hd, tokens.shape[1], mcfg.rope_theta)
         (x, _, _), (ks, vs) = jax.lax.scan(
             _prefill_layer, (x, cos, sin), params["layers"])
         x = rms_norm(x, params["final_norm"], mcfg.norm_eps)
@@ -193,6 +197,9 @@ class Engine:
     """One continuous-batching decode loop. submit() from any thread;
     each request streams token chunks through its own queue."""
 
+    # Smallest prefill bucket; buckets double up to max_seq.
+    _MIN_BUCKET = 32
+
     def __init__(self, params, mcfg, *, n_slots: int = 8,
                  decode_chunk: int = 4):
         import jax
@@ -208,6 +215,15 @@ class Engine:
         self._prefill, self._decode, empty = _build_fns(
             mcfg, n_slots, decode_chunk)
         self._kc, self._vc = empty()
+        # Prefill shape buckets (powers of 2, capped at max_seq): a
+        # 50-token prompt prefills 64 wide, not max_seq wide — the TTFT
+        # lever the reference gets from vLLM's chunked prefill.
+        self.buckets: List[int] = []
+        b = min(self._MIN_BUCKET, mcfg.max_seq)
+        while b < mcfg.max_seq:
+            self.buckets.append(b)
+            b *= 2
+        self.buckets.append(mcfg.max_seq)
         # host-side slot state
         self._slot_req: List[Optional[_Request]] = [None] * n_slots
         self._pos = np.zeros(n_slots, np.int32)
@@ -217,11 +233,13 @@ class Engine:
         self._wake = threading.Event()
         self._stop = False
         self.error: Optional[str] = None
-        # Warm both compiled shapes BEFORE serving (serve's startup grace
-        # covers the XLA compile).
-        toks = jnp.zeros((1, mcfg.max_seq), jnp.int32)
-        self._kc, self._vc, first = self._prefill(
-            self.params, self._kc, self._vc, 0, toks, 1)
+        # Warm the decode program + the SMALLEST and LARGEST prefill
+        # buckets before serving (serve's startup grace covers the XLA
+        # compiles); intermediate buckets compile on first use.
+        for width in {self.buckets[0], self.buckets[-1]}:
+            toks = jnp.zeros((1, width), jnp.int32)
+            self._kc, self._vc, first = self._prefill(
+                self.params, self._kc, self._vc, 0, toks, 1)
         self._kc, self._vc, last, pos, out = self._decode(
             self.params, self._kc, self._vc,
             jnp.zeros(n_slots, jnp.int32), jnp.zeros(n_slots, jnp.int32),
@@ -260,7 +278,8 @@ class Engine:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 return
-            toks = np.zeros((1, self.mcfg.max_seq), np.int32)
+            width = next(b for b in self.buckets if b >= len(req.ids))
+            toks = np.zeros((1, width), np.int32)
             toks[0, :len(req.ids)] = req.ids
             self._kc, self._vc, first = self._prefill(
                 self.params, self._kc, self._vc, slot, jnp.asarray(toks),
