@@ -169,6 +169,39 @@ int store_create_object(void* handle, const char* id, uint64_t data_size,
   return 0;
 }
 
+// Ingest a fully-written payload file as a SEALED object in one step
+// (worker writes <dir>/ingest-* directly, then one RPC lands here —
+// halves the control round-trips of the create+write+seal protocol).
+// 0 ok, -1 already exists, -2 out of memory (after eviction), -3 io error.
+int store_ingest_object(void* handle, const char* id, const char* src_path,
+                        uint64_t data_size, uint64_t meta_size) {
+  auto* s = static_cast<Store*>(handle);
+  std::string key = IdKey(id);
+  uint64_t total = data_size + meta_size;
+  std::string path;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (s->objects.count(key)) return -1;
+    if (total > s->capacity) return -2;
+    if (!EvictFor(s, total)) return -2;
+    path = HexPath(*s, key);
+    ObjectEntry e;
+    e.path = path;
+    e.data_size = data_size;
+    e.meta_size = meta_size;
+    e.sealed = true;
+    s->used += total;
+    auto ins = s->objects.emplace(key, std::move(e));
+    LruPush(s, key, &ins.first->second);
+  }
+  if (::rename(src_path, path.c_str()) != 0) {
+    std::lock_guard<std::mutex> g(s->mu);
+    EraseObject(s, key);
+    return -3;
+  }
+  return 0;
+}
+
 // 0 ok, -1 missing.
 int store_seal(void* handle, const char* id) {
   auto* s = static_cast<Store*>(handle);

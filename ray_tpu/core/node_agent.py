@@ -280,8 +280,26 @@ class NodeAgent:
         workers = M.Gauge("raytpu_workers", "worker processes alive")
         leases = M.Gauge("raytpu_active_leases", "granted worker leases")
         period = max(0.5, GlobalConfig.metrics_report_period_ms / 1000)
+        last_sweep = 0.0
         while not self._shutdown:
             await asyncio.sleep(period)
+            # Sweep orphaned ingest files (a worker that died between its
+            # direct write and the store_ingest RPC leaks one tmp file).
+            now = time.monotonic()
+            if now - last_sweep > 30.0:
+                last_sweep = now
+                try:
+                    for name in os.listdir(self.store.dir):
+                        if not name.startswith("ingest-"):
+                            continue
+                        p = os.path.join(self.store.dir, name)
+                        try:
+                            if time.time() - os.path.getmtime(p) > 120:
+                                os.unlink(p)
+                        except OSError:
+                            pass
+                except OSError:
+                    pass
             try:
                 store_used.set(self.store.used())
                 store_objs.set(self.store.num_objects())
@@ -858,30 +876,68 @@ class NodeAgent:
     # ------------------------------------------------------------------
     async def store_create(self, oid: bytes, data_size: int,
                            meta_size: int) -> str:
+        return await self._with_spill_retry(
+            lambda: self.store.create(ObjectID(oid), data_size, meta_size),
+            data_size + meta_size)
+
+    async def store_info(self) -> dict:
+        """Store facts a local worker needs for the direct-write put path."""
+        return {"dir": self.store.dir}
+
+    async def _with_spill_retry(self, op, total: int):
+        """Run a store-admission op, spilling/queueing on full (shared
+        backpressure for create and ingest; reference:
+        plasma/create_request_queue.cc)."""
         from ray_tpu.core.object_store import ObjectStoreFullError
-        if data_size + meta_size > self.store.capacity():
+        if total > self.store.capacity():
             # Larger than the whole store: spilling can never help.
             raise ObjectStoreFullError(
-                f"object of {data_size + meta_size} bytes exceeds store "
-                f"capacity {self.store.capacity()}")
+                f"object of {total} bytes exceeds store capacity "
+                f"{self.store.capacity()}")
         deadline = asyncio.get_running_loop().time() + 5.0
         while True:
             try:
-                return self.store.create(ObjectID(oid), data_size, meta_size)
+                return op()
             except ObjectStoreFullError:
                 # Unpinned (secondary) copies were already LRU-evicted by
                 # the native store; make room by spilling pinned primaries
-                # to disk, then briefly queue the create while in-flight
-                # readers release space (reference:
-                # plasma/create_request_queue.cc backpressure).
-                await self._spill_for(data_size + meta_size)
+                # to disk, then briefly queue while in-flight readers
+                # release space.
+                await self._spill_for(total)
                 try:
-                    return self.store.create(ObjectID(oid), data_size,
-                                             meta_size)
+                    return op()
                 except ObjectStoreFullError:
                     if asyncio.get_running_loop().time() >= deadline:
                         raise
                     await asyncio.sleep(0.1)
+
+    async def store_ingest(self, oid: bytes, src_name: str, data_size: int,
+                           meta_size: int) -> None:
+        """One-RPC put: the worker already wrote `<store_dir>/<src_name>`;
+        account + evict/spill if needed + rename it in as a SEALED
+        primary. Collapses the create+seal round-trips (the accounting
+        window moves to ingest time — tmpfs briefly holds the payload
+        unaccounted, bounded by the writer's in-flight puts)."""
+        if not src_name.startswith("ingest-") or "/" in src_name:
+            raise ValueError(f"bad ingest source {src_name!r}")
+        src = os.path.join(self.store.dir, src_name)
+        o = ObjectID(oid)
+        try:
+            await self._with_spill_retry(
+                lambda: self.store.ingest(o, src, data_size, meta_size),
+                data_size + meta_size)
+        except BaseException:
+            try:
+                os.unlink(src)  # never strand the payload in tmpfs
+            except OSError:
+                pass
+            raise
+        # Same bookkeeping as store_seal: primary pin + seal waiters.
+        self.store.pin(o)
+        self._primary[oid] = data_size + meta_size
+        ev = self._seal_waiters.pop(oid, None)
+        if ev:
+            ev.set()
 
     async def store_seal(self, oid: bytes, owner_addr=None,
                          size: int = 0) -> None:

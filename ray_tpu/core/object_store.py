@@ -68,6 +68,10 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_int]
     lib.store_seal.restype = ctypes.c_int
     lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.store_ingest_object.restype = ctypes.c_int
+    lib.store_ingest_object.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_uint64]
     lib.store_get.restype = ctypes.c_int
     lib.store_get.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
@@ -123,6 +127,26 @@ class LocalObjectStore:
     def seal(self, oid: ObjectID) -> None:
         if self._lib.store_seal(self._handle, oid.binary()) != 0:
             raise KeyError(f"seal: no such object {oid}")
+
+    @property
+    def dir(self) -> str:
+        return self._dir
+
+    def ingest(self, oid: ObjectID, src_path: str, data_size: int,
+               meta_size: int = 0) -> None:
+        """Adopt a fully-written payload file as a sealed object (the
+        one-RPC put path: the writer produced src_path in the store dir;
+        the store accounts, evicts if needed, and renames it in)."""
+        rc = self._lib.store_ingest_object(
+            self._handle, oid.binary(), src_path.encode(), data_size,
+            meta_size)
+        if rc == -1:
+            raise FileExistsError(f"object exists: {oid}")
+        if rc == -2:
+            raise ObjectStoreFullError(
+                f"cannot fit {data_size + meta_size} bytes")
+        if rc != 0:
+            raise OSError(f"store ingest failed rc={rc}")
 
     def get(self, oid: ObjectID) -> Optional[Tuple[str, int, int]]:
         """Pin + return (path, data_size, meta_size), or None if absent/unsealed."""

@@ -214,6 +214,7 @@ class Engine:
         self.params = params
         self._prefill, self._decode, empty = _build_fns(
             mcfg, n_slots, decode_chunk)
+        self._empty = empty
         self._kc, self._vc = empty()
         # Prefill shape buckets (powers of 2, capped at max_seq): a
         # 50-token prompt prefills 64 wide, not max_seq wide — the TTFT
@@ -235,8 +236,12 @@ class Engine:
         self.error: Optional[str] = None
         # Warm the decode program + the SMALLEST and LARGEST prefill
         # buckets before serving (serve's startup grace covers the XLA
-        # compiles); intermediate buckets compile on first use.
-        for width in {self.buckets[0], self.buckets[-1]}:
+        # compiles); intermediate buckets warm in a BACKGROUND thread —
+        # until one is ready, prompts round UP to the next warmed bucket,
+        # so an unwarmed shape never compiles inside the engine loop
+        # (which would freeze every in-flight decode stream).
+        self._warm = {self.buckets[0], self.buckets[-1]}
+        for width in sorted(self._warm):
             toks = jnp.zeros((1, width), jnp.int32)
             self._kc, self._vc, first = self._prefill(
                 self.params, self._kc, self._vc, 0, toks, 1)
@@ -248,6 +253,32 @@ class Engine:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="llm-engine")
         self._thread.start()
+        middles = [b for b in self.buckets if b not in self._warm]
+        if middles:
+            threading.Thread(target=self._warm_buckets, args=(middles,),
+                             daemon=True, name="llm-bucket-warm").start()
+
+    def _warm_buckets(self, widths: List[int]) -> None:
+        """Warm intermediate prefill buckets off the engine loop; each
+        becomes eligible the moment its compile lands. Runs real calls
+        (the only way to reliably populate jit's dispatch cache) against
+        a SCRATCH kv arena — the live arenas are donated on every engine
+        call and must never be touched from this thread. Costs one
+        transient extra arena while warming."""
+        import jax.numpy as jnp
+        try:
+            kc, vc = self._empty()
+            for width in widths:
+                if self._stop:
+                    return
+                toks = jnp.zeros((1, width), jnp.int32)
+                kc, vc, first = self._prefill(self.params, kc, vc, 0,
+                                              toks, 1)
+                int(first)  # host sync: compile fully landed
+                self._warm.add(width)
+        except Exception:
+            return  # engine shutting down / compile failure: keep
+            # serving via the already-warm buckets
 
     # ------------------------------------------------------------------
     def submit(self, ids: List[int], max_tokens: int) -> "queue.Queue":
@@ -278,7 +309,10 @@ class Engine:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 return
-            width = next(b for b in self.buckets if b >= len(req.ids))
+            # Only WARMED buckets are eligible (round up until the
+            # background warm lands) — never compile in the engine loop.
+            width = next(b for b in self.buckets
+                         if b >= len(req.ids) and b in self._warm)
             toks = np.zeros((1, width), np.int32)
             toks[0, :len(req.ids)] = req.ids
             self._kc, self._vc, first = self._prefill(
