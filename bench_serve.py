@@ -22,6 +22,8 @@ import urllib.request
 
 QUICK = "--quick" in sys.argv
 TTFT_ONLY = "--ttft-only" in sys.argv  # solo TTFT + decode rate, no sweep
+PD = "--pd" in sys.argv  # disaggregated prefill/decode pools instead of
+# the monolithic engine (reference: prefill_decode_disagg.py)
 
 
 def emit(metric: str, value: float, unit: str) -> None:
@@ -30,6 +32,14 @@ def emit(metric: str, value: float, unit: str) -> None:
 
 
 def main() -> None:
+    import os
+    if PD:
+        # PD needs one chip PER POOL (TPU requests are whole chips and a
+        # PJRT chip is process-exclusive); this harness has one, so --pd
+        # runs both pools on CPU jax — a structural comparison of the
+        # disaggregated path (compare against a plain CPU run).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     import ray_tpu
     import ray_tpu.serve as serve
     from ray_tpu.serve.llm import LLMConfig, build_llm_app
@@ -44,10 +54,14 @@ def main() -> None:
             d_model=512 if QUICK else 1024,
             n_layers=4 if QUICK else 8,
             max_seq=256,
-            num_tpus=1,
+            num_tpus=0 if PD else 1,
             max_ongoing_requests=8,   # KV arena slots
             decode_chunk=4)
-        serve.run(build_llm_app(cfg), name="llama")
+        if PD:
+            from ray_tpu.serve.llm import run_pd_llm_app
+            run_pd_llm_app(cfg, name="llama")
+        else:
+            serve.run(build_llm_app(cfg), name="llama")
         port = serve.get_proxy().port
         url = f"http://127.0.0.1:{port}/llama"
 

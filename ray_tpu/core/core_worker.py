@@ -257,7 +257,12 @@ class CoreWorker:
                 self._spawn_scheduled = True
                 self._loop.call_soon_threadsafe(self._drain_spawns)
         except RuntimeError:  # loop shut down mid-call
-            coro.close()
+            # Reset the flag and close EVERYTHING buffered (including
+            # this coro) — a stuck True flag would silently drop every
+            # later fire-and-forget coroutine un-closed.
+            self._spawn_scheduled = False
+            while self._spawn_buf:
+                self._spawn_buf.popleft().close()
 
     def _drain_spawns(self) -> None:
         # Clear the flag BEFORE draining: a concurrent producer either

@@ -29,30 +29,21 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 
-def _build_fns(mcfg, n_slots: int, chunk: int):
-    """Build (prefill_jit, decode_jit, empty_caches) for the config."""
+def _make_prefill_core(mcfg):
+    """fn(params, tokens[1, B], length) -> (first_token, ks, vs) where
+    ks/vs are [L, B, KVH, hd] — the shared prefill pass used by the
+    in-engine prefill AND the disaggregated PrefillServer (reference:
+    llm/_internal/serve/deployments/prefill_decode_disagg/ — there the
+    split is two vLLM pools; here both halves share one traced core)."""
     import jax
     import jax.numpy as jnp
 
     from ray_tpu.ops.attention import flash_attention, repeat_kv
     from ray_tpu.ops.norms import apply_rope, rms_norm, rope_frequencies
 
-    if mcfg.n_experts > 0:
-        raise ValueError("the serving engine supports dense models only")
-
-    S = mcfg.max_seq
     H, KVH, hd = mcfg.n_heads, mcfg.n_kv_heads, mcfg.head_dim
-    D = mcfg.d_model
     dt = mcfg.dtype
-    ns = n_slots
 
-    def empty_caches():
-        shape = (mcfg.n_layers, ns, S, KVH, hd)
-        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
-
-    # ------------------------------------------------------------------
-    # prefill: full causal pass over ONE padded prompt, caching k/v
-    # ------------------------------------------------------------------
     def _prefill_layer(carry, lp):
         x, cos, sin = carry
         B, Sq, _ = x.shape
@@ -78,11 +69,7 @@ def _build_fns(mcfg, n_slots: int, chunk: int):
         return (x, cos, sin), (k[0].transpose(1, 0, 2),
                                v[0].transpose(1, 0, 2))
 
-    def prefill(params, kc, vc, slot, tokens, length):
-        """tokens [1, B] padded to a BUCKET width (powers of 2 up to
-        max_seq — jax.jit compiles one program per bucket shape, so a
-        short prompt pays a short prefill, not a max_seq one); writes
-        slot's k/v, returns the first generated token (greedy)."""
+    def core(params, tokens, length):
         x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
         cos, sin = rope_frequencies(hd, tokens.shape[1], mcfg.rope_theta)
         (x, _, _), (ks, vs) = jax.lax.scan(
@@ -93,10 +80,53 @@ def _build_fns(mcfg, n_slots: int, chunk: int):
         logits = jnp.einsum("bd,dv->bv", last_h,
                             params["lm_head"].astype(dt))
         first = jnp.argmax(logits[0]).astype(jnp.int32)
-        # ks/vs: [L, S, KVH, hd] -> arena slot (dynamic slot index)
+        return first, ks, vs
+
+    return core
+
+
+def _build_fns(mcfg, n_slots: int, chunk: int):
+    """Build (prefill_jit, decode_jit, adopt_jit, empty_caches)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.norms import rms_norm, rope_frequencies
+
+    if mcfg.n_experts > 0:
+        raise ValueError("the serving engine supports dense models only")
+
+    S = mcfg.max_seq
+    H, KVH, hd = mcfg.n_heads, mcfg.n_kv_heads, mcfg.head_dim
+    D = mcfg.d_model
+    dt = mcfg.dtype
+    ns = n_slots
+
+    def empty_caches():
+        shape = (mcfg.n_layers, ns, S, KVH, hd)
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    # ------------------------------------------------------------------
+    # prefill: full causal pass over ONE padded prompt, caching k/v
+    # ------------------------------------------------------------------
+    _core = _make_prefill_core(mcfg)
+
+    def prefill(params, kc, vc, slot, tokens, length):
+        """tokens [1, B] padded to a BUCKET width (powers of 2 up to
+        max_seq — jax.jit compiles one program per bucket shape, so a
+        short prompt pays a short prefill, not a max_seq one); writes
+        slot's k/v, returns the first generated token (greedy)."""
+        first, ks, vs = _core(params, tokens, length)
+        # ks/vs: [L, B, KVH, hd] -> arena slot (dynamic slot index)
         kc = jax.lax.dynamic_update_slice(kc, ks[:, None], (0, slot, 0, 0, 0))
         vc = jax.lax.dynamic_update_slice(vc, vs[:, None], (0, slot, 0, 0, 0))
         return kc, vc, first
+
+    def adopt(kc, vc, slot, ks, vs):
+        """Write externally-prefilled k/v (a PrefillServer handoff) into
+        a slot of the arena."""
+        kc = jax.lax.dynamic_update_slice(kc, ks[:, None], (0, slot, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, vs[:, None], (0, slot, 0, 0, 0))
+        return kc, vc
 
     # ------------------------------------------------------------------
     # decode: one token for every active slot per step, `chunk` steps
@@ -179,18 +209,27 @@ def _build_fns(mcfg, n_slots: int, chunk: int):
     import jax as _jax
     prefill_jit = _jax.jit(prefill, donate_argnums=(1, 2))
     decode_jit = _jax.jit(decode, donate_argnums=(1, 2))
-    return prefill_jit, decode_jit, empty_caches
+    adopt_jit = _jax.jit(adopt, donate_argnums=(0, 1))
+    return prefill_jit, decode_jit, adopt_jit, empty_caches
 
 
 class _Request:
-    __slots__ = ("ids", "max_tokens", "out", "produced", "slot")
+    __slots__ = ("ids", "max_tokens", "out", "produced", "slot",
+                 "adopt_kv", "first")
 
-    def __init__(self, ids: List[int], max_tokens: int):
+    def __init__(self, ids: List[int], max_tokens: int,
+                 adopt_kv: Optional[Tuple[Any, Any]] = None,
+                 first: int = -1):
         self.ids = ids
         self.max_tokens = max_tokens
         self.out: "queue.Queue[Optional[List[int]]]" = queue.Queue()
         self.produced = 0
         self.slot = -1
+        # Disaggregated handoff: (ks, vs) prefilled elsewhere + the first
+        # generated token (already streamed to the client by the prefill
+        # side, so this engine never re-emits it).
+        self.adopt_kv = adopt_kv
+        self.first = first
 
 
 class Engine:
@@ -212,7 +251,7 @@ class Engine:
         self.n_slots = n_slots
         self.chunk = decode_chunk
         self.params = params
-        self._prefill, self._decode, empty = _build_fns(
+        self._prefill, self._decode, self._adopt, empty = _build_fns(
             mcfg, n_slots, decode_chunk)
         self._empty = empty
         self._kc, self._vc = empty()
@@ -245,6 +284,11 @@ class Engine:
             toks = jnp.zeros((1, width), jnp.int32)
             self._kc, self._vc, first = self._prefill(
                 self.params, self._kc, self._vc, 0, toks, 1)
+            # PD adopt program for the same width (arena is all-zeros
+            # here, so the slot-0 write is a no-op).
+            kv = jnp.zeros((mcfg.n_layers, width, mcfg.n_kv_heads,
+                            mcfg.head_dim), mcfg.dtype)
+            self._kc, self._vc = self._adopt(self._kc, self._vc, 0, kv, kv)
         self._kc, self._vc, last, pos, out = self._decode(
             self.params, self._kc, self._vc,
             jnp.zeros(n_slots, jnp.int32), jnp.zeros(n_slots, jnp.int32),
@@ -268,6 +312,7 @@ class Engine:
         import jax.numpy as jnp
         try:
             kc, vc = self._empty()
+            m = self.mcfg
             for width in widths:
                 if self._stop:
                     return
@@ -275,6 +320,11 @@ class Engine:
                 kc, vc, first = self._prefill(self.params, kc, vc, 0,
                                               toks, 1)
                 int(first)  # host sync: compile fully landed
+                # Warm the PD adopt program for this width too (a first
+                # cross-pool handoff must not compile in the loop).
+                kv = jnp.zeros((m.n_layers, width, m.n_kv_heads,
+                                m.head_dim), m.dtype)
+                kc, vc = self._adopt(kc, vc, 0, kv, kv)
                 self._warm.add(width)
         except Exception:
             return  # engine shutting down / compile failure: keep
@@ -289,6 +339,24 @@ class Engine:
         req = _Request(ids[: self.mcfg.max_seq - 1], max_tokens)
         if max_tokens <= 0:
             req.out.put(None)  # nothing to generate; skip the prefill too
+            return req.out
+        self._pending.put(req)
+        self._wake.set()
+        return req.out
+
+    def submit_prefilled(self, ks: Any, vs: Any, length: int, first: int,
+                         max_tokens: int) -> "queue.Queue":
+        """Adopt an externally-prefilled request (PD disaggregation): the
+        KV [L, B, KVH, hd] was produced by a PrefillServer and handed
+        over via DeviceRefs; this engine continues decoding from token
+        `first` at position `length`. The stream yields only tokens
+        AFTER `first` (the prefill side already delivered it)."""
+        if self.error is not None or not self._thread.is_alive():
+            raise RuntimeError(f"LLM engine died:\n{self.error}")
+        req = _Request([0] * min(length, self.mcfg.max_seq - 1),
+                       max_tokens, adopt_kv=(ks, vs), first=first)
+        if max_tokens <= 1:
+            req.out.put(None)  # prefill's first token was the whole ask
             return req.out
         self._pending.put(req)
         self._wake.set()
@@ -309,23 +377,48 @@ class Engine:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 return
-            # Only WARMED buckets are eligible (round up until the
-            # background warm lands) — never compile in the engine loop.
-            width = next(b for b in self.buckets
-                         if b >= len(req.ids) and b in self._warm)
-            toks = np.zeros((1, width), np.int32)
-            toks[0, :len(req.ids)] = req.ids
-            self._kc, self._vc, first = self._prefill(
-                self.params, self._kc, self._vc, slot, jnp.asarray(toks),
-                len(req.ids))
-            first = int(first)
+            if req.adopt_kv is not None:
+                # Disaggregated handoff: write the external KV into the
+                # slot; `first` was already streamed by the prefill side.
+                # An UNWARMED handoff width is host-padded to the next
+                # warmed bucket (a zero tail is never attended — the
+                # attention mask stops at pos) instead of compiling a
+                # fresh adopt program inside the loop.
+                ks, vs = req.adopt_kv
+                req.adopt_kv = None
+                width = ks.shape[1]
+                if width not in self._warm:
+                    target = next(b for b in self.buckets
+                                  if b >= width and b in self._warm)
+                    pk = np.zeros((ks.shape[0], target) + ks.shape[2:],
+                                  np.asarray(ks).dtype)
+                    pv = np.zeros_like(pk)
+                    pk[:, :width] = np.asarray(ks)
+                    pv[:, :width] = np.asarray(vs)
+                    ks, vs = jnp.asarray(pk), jnp.asarray(pv)
+                self._kc, self._vc = self._adopt(
+                    self._kc, self._vc, slot, ks, vs)
+                first = req.first
+            else:
+                # Only WARMED buckets are eligible (round up until the
+                # background warm lands) — never compile in the engine
+                # loop.
+                width = next(b for b in self.buckets
+                             if b >= len(req.ids) and b in self._warm)
+                toks = np.zeros((1, width), np.int32)
+                toks[0, :len(req.ids)] = req.ids
+                self._kc, self._vc, first = self._prefill(
+                    self.params, self._kc, self._vc, slot,
+                    jnp.asarray(toks), len(req.ids))
+                first = int(first)
             req.slot = slot
             self._slot_req[slot] = req
             self._pos[slot] = len(req.ids)
             self._last[slot] = first
             self._active[slot] = True
             req.produced = 1
-            req.out.put([first])                 # TTFT token, immediately
+            if req.first < 0:
+                req.out.put([first])             # TTFT token, immediately
             if (req.produced >= req.max_tokens
                     or self._pos[slot] >= self.mcfg.max_seq):
                 self._finish(slot)

@@ -52,36 +52,18 @@ class LLMServer:
 
     def __init__(self, cfg_blob: bytes):
         import cloudpickle
-        import jax
-        import jax.numpy as jnp
 
-        from ray_tpu.models.llama import LlamaConfig, init_params
         from ray_tpu.serve.engine import Engine
 
         cfg: LLMConfig = cloudpickle.loads(cfg_blob)
         self.cfg = cfg
-        self.mcfg = LlamaConfig(
-            vocab_size=cfg.vocab_size, d_model=cfg.d_model,
-            n_layers=cfg.n_layers, n_heads=max(2, cfg.d_model // 128),
-            n_kv_heads=max(1, cfg.d_model // 256),
-            d_ff=int(cfg.d_model * 2.75), max_seq=cfg.max_seq)
-        if cfg.params_path:
-            from ray_tpu.train.checkpointing import load_checkpoint_host
-            host = load_checkpoint_host(cfg.params_path)
-            params = jax.tree.map(jnp.asarray, _unflatten(host))
-        else:
-            params = init_params(self.mcfg, jax.random.PRNGKey(0))
-        self.engine = Engine(jax.device_put(params), self.mcfg,
+        self.mcfg, params = _model_from_cfg(cfg)
+        self.engine = Engine(params, self.mcfg,
                              n_slots=cfg.max_ongoing_requests,
                              decode_chunk=cfg.decode_chunk)
 
     def _encode(self, prompt) -> List[int]:
-        if isinstance(prompt, list):
-            return [int(t) for t in prompt]
-        if self.cfg.tokenizer is not None:
-            return self.cfg.tokenizer(prompt)
-        raise ValueError(
-            "string prompts need LLMConfig.tokenizer; or pass token ids")
+        return _encode_prompt(self.cfg, prompt)
 
     def _decode_text(self, ids: List[int]):
         if self.cfg.detokenizer is not None:
@@ -135,3 +117,221 @@ def build_llm_app(cfg: LLMConfig):
         max_ongoing_requests=cfg.max_ongoing_requests,
     )(LLMServer)
     return dep.bind(cloudpickle.dumps(cfg))
+
+
+def _model_from_cfg(cfg: "LLMConfig"):
+    """(LlamaConfig, device params) — shared by every server flavor."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig, init_params
+
+    mcfg = LlamaConfig(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_layers=cfg.n_layers, n_heads=max(2, cfg.d_model // 128),
+        n_kv_heads=max(1, cfg.d_model // 256),
+        d_ff=int(cfg.d_model * 2.75), max_seq=cfg.max_seq)
+    if cfg.params_path:
+        from ray_tpu.train.checkpointing import load_checkpoint_host
+        host = load_checkpoint_host(cfg.params_path)
+        params = jax.tree.map(jnp.asarray, _unflatten(host))
+    else:
+        params = init_params(mcfg, jax.random.PRNGKey(0))
+    return mcfg, jax.device_put(params)
+
+
+# ---------------------------------------------------------------------------
+# Prefill/decode disaggregation (reference:
+# llm/_internal/serve/deployments/prefill_decode_disagg/
+# prefill_decode_disagg.py:177 build_pd_openai_app — two engine pools
+# joined by a KV-cache transfer backend; here the handoff rides
+# DeviceRefs over the transfer plane: DMA within a slice, host-relay
+# over DCN across slices).
+# ---------------------------------------------------------------------------
+
+def _encode_prompt(cfg: "LLMConfig", prompt) -> List[int]:
+    if isinstance(prompt, list):
+        return [int(t) for t in prompt]
+    if cfg.tokenizer is not None:
+        return cfg.tokenizer(prompt)
+    raise ValueError(
+        "string prompts need LLMConfig.tokenizer; or pass token ids")
+
+
+class PrefillServer:
+    """Prefill pool replica: one full causal pass per prompt, returning
+    the first token + the KV cache as DeviceRefs (the tensors stay in
+    this replica's HBM until the decode side pulls them)."""
+
+    def __init__(self, cfg_blob: bytes):
+        import threading
+
+        import cloudpickle
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.serve.engine import Engine, _make_prefill_core
+
+        cfg: LLMConfig = cloudpickle.loads(cfg_blob)
+        self.cfg = cfg
+        self.mcfg, self.params = _model_from_cfg(cfg)
+        self._core = jax.jit(_make_prefill_core(self.mcfg))
+        # Same bucket ladder + warm policy as the engine: smallest and
+        # largest warm eagerly; intermediates warm in the background and
+        # requests round UP to a warmed width until then (a synchronous
+        # compile inside a request would spike TTFT for everything
+        # queued behind it).
+        self.buckets: List[int] = []
+        b = min(Engine._MIN_BUCKET, self.mcfg.max_seq)
+        while b < self.mcfg.max_seq:
+            self.buckets.append(b)
+            b *= 2
+        self.buckets.append(self.mcfg.max_seq)
+        self._warm = {self.buckets[0], self.buckets[-1]}
+
+        def warm(width: int) -> None:
+            out = self._core(self.params,
+                             jnp.zeros((1, width), jnp.int32), 1)
+            jax.block_until_ready(out)
+
+        for width in sorted(self._warm):
+            warm(width)
+
+        def warm_rest():
+            for width in self.buckets:
+                if width not in self._warm:
+                    try:
+                        warm(width)
+                        self._warm.add(width)
+                    except Exception:
+                        return
+
+        threading.Thread(target=warm_rest, daemon=True,
+                         name="prefill-bucket-warm").start()
+
+    def prefill(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.device_objects import device_put_ref
+
+        ids = _encode_prompt(self.cfg, body.get("prompt", [1]))
+        ids = ids[: self.mcfg.max_seq - 1]
+        width = next(b for b in self.buckets
+                     if b >= len(ids) and b in self._warm)
+        toks = np.zeros((1, width), np.int32)
+        toks[0, :len(ids)] = ids
+        first, ks, vs = self._core(self.params, jnp.asarray(toks),
+                                   len(ids))
+        return {
+            "first": int(first),
+            "length": len(ids),
+            "k": device_put_ref(ks),
+            "v": device_put_ref(vs),
+        }
+
+
+class DecodeServer:
+    """Decode pool replica: the continuous-batching engine, fed by
+    KV handoffs from the prefill pool."""
+
+    def __init__(self, cfg_blob: bytes):
+        import cloudpickle
+
+        from ray_tpu.serve.engine import Engine
+
+        cfg: LLMConfig = cloudpickle.loads(cfg_blob)
+        self.cfg = cfg
+        self.mcfg, params = _model_from_cfg(cfg)
+        self.engine = Engine(params, self.mcfg,
+                             n_slots=cfg.max_ongoing_requests,
+                             decode_chunk=cfg.decode_chunk)
+
+    def decode_stream(self, meta: Dict[str, Any]):
+        """Pull the prefilled KV (device plane; slice-aware) and stream
+        the remaining tokens."""
+        from ray_tpu.device_objects import device_get, free_ref
+
+        kref, vref = meta["k"], meta["v"]
+        ks = device_get(kref, timeout=120.0)
+        vs = device_get(vref, timeout=120.0)
+        # The prefill side's HBM copy is no longer needed.
+        for r in (kref, vref):
+            try:
+                free_ref(r)
+            except Exception:
+                pass
+        stream = self.engine.submit_prefilled(
+            ks, vs, meta["length"], meta["first"], meta["max_tokens"])
+        while True:
+            toks = stream.get()
+            if toks is None:
+                return
+            yield toks
+
+
+class PDIngress:
+    """Router deployment: prompt -> prefill pool, stream -> decode pool
+    (the reference's PDProxyServer shape). The first token streams to
+    the client straight from the prefill reply — decode-pool admission
+    never sits in front of TTFT."""
+
+    def __init__(self, cfg_blob: bytes, prefill_name: str,
+                 decode_name: str):
+        import cloudpickle
+
+        self.cfg: LLMConfig = cloudpickle.loads(cfg_blob)
+        self._prefill = serve.get_deployment_handle(prefill_name)
+        self._decode = serve.get_deployment_handle(decode_name)
+
+    def _decode_text(self, ids: List[int]):
+        out = self.cfg.detokenizer(ids) if self.cfg.detokenizer \
+            is not None else ids
+        return out if isinstance(out, str) \
+            else " ".join(str(t) for t in out) + " "
+
+    def __call__(self, body: Dict[str, Any]):
+        max_new = int(body.get("max_tokens", 16))
+        meta = self._prefill.options(method_name="prefill").remote(
+            body).result(timeout=300)
+        yield self._decode_text([meta["first"]])
+        if max_new <= 1:
+            return
+        meta["max_tokens"] = max_new
+        for toks in self._decode.options(
+                method_name="decode_stream").stream(meta):
+            yield self._decode_text(toks)
+
+    def complete(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        text = "".join(self(body))
+        return {"object": "text_completion",
+                "model": f"ray_tpu-llama-pd-{self.cfg.d_model}",
+                "choices": [{"index": 0, "text": text,
+                             "finish_reason": "length"}]}
+
+
+def run_pd_llm_app(cfg: LLMConfig, *, name: str = "llm-pd",
+                   num_prefill_replicas: int = 1,
+                   num_decode_replicas: int = 1):
+    """Deploy the disaggregated app: prefill pool + decode pool +
+    ingress; returns the ingress handle (reference:
+    prefill_decode_disagg.py:177 build_pd_openai_app)."""
+    import cloudpickle
+
+    blob = cloudpickle.dumps(cfg)
+    prefill_dep = serve.deployment(
+        name=f"{name}-prefill", num_replicas=num_prefill_replicas,
+        num_tpus=cfg.num_tpus,
+        max_ongoing_requests=cfg.max_ongoing_requests)(PrefillServer)
+    decode_dep = serve.deployment(
+        name=f"{name}-decode", num_replicas=num_decode_replicas,
+        num_tpus=cfg.num_tpus,
+        max_ongoing_requests=cfg.max_ongoing_requests)(DecodeServer)
+    ingress_dep = serve.deployment(
+        name=name, num_replicas=1,
+        max_ongoing_requests=4 * cfg.max_ongoing_requests)(PDIngress)
+    serve.run(prefill_dep.bind(blob), name=f"{name}-prefill")
+    serve.run(decode_dep.bind(blob), name=f"{name}-decode")
+    return serve.run(
+        ingress_dep.bind(blob, f"{name}-prefill", f"{name}-decode"),
+        name=name)
