@@ -1,17 +1,23 @@
 from ray_tpu.train.api_config import (CheckpointConfig, FailureConfig,
                                       Result, RunConfig, ScalingConfig)
-from ray_tpu.train.checkpointing import (Checkpoint, CheckpointManager,
+from ray_tpu.train.checkpointing import (AsyncCheckpointer, Checkpoint,
+                                         CheckpointManager,
                                          load_checkpoint_host,
                                          restore_checkpoint)
 from ray_tpu.train.jax_trainer import JaxTrainer
+from ray_tpu.train.scaling_policy import (ElasticScalingPolicy,
+                                          FixedScalingPolicy,
+                                          ScalingPolicy)
 from ray_tpu.train.session import (get_context, get_dataset_shard, profile,
                                    report, save_checkpoint)
 from ray_tpu.train.spmd import (default_optimizer, make_train_fns,
                                 state_shardings)
 
 __all__ = [
-    "Checkpoint", "CheckpointConfig", "CheckpointManager", "FailureConfig",
-    "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
+    "AsyncCheckpointer", "Checkpoint", "CheckpointConfig",
+    "CheckpointManager",
+    "ElasticScalingPolicy", "FailureConfig", "FixedScalingPolicy",
+    "JaxTrainer", "Result", "RunConfig", "ScalingConfig", "ScalingPolicy",
     "default_optimizer", "get_context", "get_dataset_shard",
     "load_checkpoint_host", "make_train_fns", "profile", "report",
     "restore_checkpoint", "save_checkpoint", "state_shardings",

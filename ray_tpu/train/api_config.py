@@ -30,6 +30,12 @@ class ScalingConfig:
     resources_per_worker: Dict[str, float] = field(default_factory=dict)
     placement_strategy: str = "PACK"
     topology: str = ""
+    # Elastic mode (reference: train/v2 ScalingPolicy resize decisions):
+    # with max_workers set, the controller tracks cluster capacity in
+    # [min_workers or num_workers, max_workers] — a node join re-gangs
+    # the job larger from the latest checkpoint; a loss shrinks it.
+    min_workers: Optional[int] = None
+    max_workers: Optional[int] = None
 
     def bundle(self) -> Dict[str, float]:
         res = {"CPU": 1.0}

@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -117,82 +118,200 @@ def save_checkpoint(directory: str, state: Any, step: int,
                     metrics: Optional[Dict[str, Any]] = None) -> Checkpoint:
     """Save a pytree of jax.Arrays (or numpy/scalars). Call from EVERY
     process in a multi-host run — each writes its replica-0 addressable
-    shards; commit happens after the global barrier."""
+    shards; commit happens after the global barrier. (The sync flavor:
+    snapshot + write on this thread with DEVICE barriers; the async
+    flavor below runs the same phases with a marker-file barrier.)"""
     import jax
 
-    proc = jax.process_index()
-    final_dir = os.path.join(directory, f"step-{step}")
-    # All writes land in a TEMP dir; the committed dir is replaced by an
-    # atomic swap at the very end. Two guarantees: (a) a crashed save
-    # never mixes stale shards into a later save of the same step
-    # (backstopped by the exact shard manifest in _METADATA.json too);
-    # (b) an existing COMMITTED step-N stays restorable until the new
-    # save is fully durable.
+    _prepare_save(directory, step)
+    snap = _snapshot(state, step, metrics)
+    ckpt = _write_snapshot(directory, snap, device_barrier=True)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt-visible-{step}")
+    return ckpt
+
+
+def _prepare_save(directory: str, step: int) -> None:
+    """On-thread pre-save: recover any trashed commit, clear stale tmp
+    state (process 0), and line every process up behind that clear."""
+    import jax
+
     ckpt_dir = os.path.join(directory, f"_tmp-step-{step}")
-    if proc == 0:
+    if jax.process_index() == 0:
         _recover_trashed(directory, step)
         if os.path.isdir(ckpt_dir):
             shutil.rmtree(ckpt_dir, ignore_errors=True)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt-begin-{step}")
-    os.makedirs(ckpt_dir, exist_ok=True)
 
+
+def _snapshot(state: Any, step: int,
+              metrics: Optional[Dict[str, Any]]) -> dict:
+    """Device->host snapshot + metadata plan — the ONLY phase that must
+    pause the training loop (HBM->RAM copies of this process's replica-0
+    shards). Arrays are COPIED: on backends where __array__ is zero-copy
+    (CPU), a donated buffer would otherwise be reused by the next train
+    step while a background writer still reads it."""
+    import jax
+
+    proc = jax.process_index()
     flat = _leaf_paths(state)
     meta: Dict[str, Any] = {"step": step, "leaves": [],
                             "metrics": dict(metrics or {})}
+    writes: List[Tuple[str, np.ndarray]] = []  # (filename, host array)
     for li, (name, leaf) in enumerate(flat):
         if isinstance(leaf, jax.Array):
             shape = tuple(leaf.shape)
-            dtype = str(leaf.dtype)
             for shard in leaf.addressable_shards:
                 if shard.replica_id == 0:
                     key = _index_key(shard.index, shape)
-                    np.save(os.path.join(ckpt_dir, f"leaf{li}.{key}.npy"),
-                            np.asarray(shard.data), allow_pickle=False)
+                    writes.append((f"leaf{li}.{key}.npy",
+                                   np.array(shard.data, copy=True)))
             # Manifest: the exact global shard-key set (computable on any
-            # process from the global sharding) — readers trust only these
-            # files, so stale shards from a crashed save are never merged.
+            # process from the global sharding) — readers trust only
+            # these files, so stale shards from a crashed save are never
+            # merged.
             all_keys = sorted({_index_key(idx, shape) for idx in
                                leaf.sharding.devices_indices_map(
                                    shape).values()})
             meta["leaves"].append({"name": name, "kind": "array",
-                                   "shape": shape, "dtype": dtype,
+                                   "shape": shape,
+                                   "dtype": str(leaf.dtype),
                                    "files": all_keys})
         else:
             if proc == 0:
-                np.save(os.path.join(ckpt_dir, f"leaf{li}.host.npy"),
-                        np.asarray(leaf), allow_pickle=False)
+                writes.append((f"leaf{li}.host.npy",
+                               np.array(leaf, copy=True)))
             meta["leaves"].append({"name": name, "kind": "host",
                                    "shape": tuple(np.shape(leaf)),
                                    "dtype": str(np.asarray(leaf).dtype),
                                    "files": ["host"]})
+    return {"meta": meta, "writes": writes, "step": step,
+            "proc": proc, "nprocs": jax.process_count()}
+
+
+def _write_snapshot(directory: str, snap: dict,
+                    barrier_timeout: float = 600.0,
+                    device_barrier: bool = False) -> Checkpoint:
+    """Write a snapshot's files and commit (the shared back half of sync
+    AND async saves). Two barrier flavors:
+
+      device_barrier=True  — sync path, runs ON the training thread:
+        sync_global_devices between writes and commit.
+      device_barrier=False — async path, runs on a background thread:
+        rank MARKER FILES on the shared checkpoint storage (a device
+        collective off-thread would interleave with the training step's
+        collectives). Every rank's Checkpoint resolves only once COMMIT
+        is visible, so reporting a resolved future is always safe.
+
+    All writes land in a TEMP dir; the committed dir is replaced by an
+    atomic swap at the very end, so (a) a crashed save never mixes stale
+    shards into a later save of the same step and (b) an existing
+    COMMITTED step-N stays restorable until the new save is durable.
+    """
+    step, proc, nprocs = snap["step"], snap["proc"], snap["nprocs"]
+    final_dir = os.path.join(directory, f"step-{step}")
+    ckpt_dir = os.path.join(directory, f"_tmp-step-{step}")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    for fname, arr in snap["writes"]:
+        np.save(os.path.join(ckpt_dir, fname), arr, allow_pickle=False)
 
     # Commit barrier: every process must have finished its writes before
-    # the checkpoint becomes observable (reference: sync_actor.py barrier;
-    # Orbax per-host write + commit).
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(f"ckpt-commit-{step}")
-    if proc == 0:
-        with open(os.path.join(ckpt_dir, "_METADATA.json"), "w") as f:
-            json.dump(meta, f)
-        with open(os.path.join(ckpt_dir, "COMMIT"), "w") as f:
-            f.write("ok")
-        # Atomic swap: the committed temp dir replaces any prior step-N.
-        # A crash before this point leaves the previous committed
-        # checkpoint untouched; the rename pair's window is microseconds
-        # (vs. the whole shard-write window if we cleared in place).
-        trash = os.path.join(directory, f"_trash-step-{step}")
-        shutil.rmtree(trash, ignore_errors=True)
-        if os.path.isdir(final_dir):
-            os.rename(final_dir, trash)
-        os.rename(ckpt_dir, final_dir)
-        shutil.rmtree(trash, ignore_errors=True)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(f"ckpt-visible-{step}")
-    return Checkpoint(final_dir, step, metrics)
+    # the checkpoint becomes observable (reference: sync_actor.py
+    # barrier; Orbax per-host write + commit).
+    if nprocs > 1:
+        if device_barrier:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"ckpt-commit-{step}")
+        else:
+            with open(os.path.join(ckpt_dir, f"_rank-{proc}.done"),
+                      "w") as f:
+                f.write("ok")
+    if proc != 0:
+        if not device_barrier:
+            _await_commit(final_dir, barrier_timeout)
+        return Checkpoint(final_dir, step, snap["meta"]["metrics"])
+    if nprocs > 1 and not device_barrier:
+        deadline = time.monotonic() + barrier_timeout
+        want = {f"_rank-{r}.done" for r in range(nprocs)}
+        while want - set(os.listdir(ckpt_dir)):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"checkpoint commit barrier: missing "
+                    f"{sorted(want - set(os.listdir(ckpt_dir)))}")
+            time.sleep(0.05)
+        for r in range(nprocs):
+            try:
+                os.unlink(os.path.join(ckpt_dir, f"_rank-{r}.done"))
+            except OSError:
+                pass
+    with open(os.path.join(ckpt_dir, "_METADATA.json"), "w") as f:
+        json.dump(snap["meta"], f)
+    with open(os.path.join(ckpt_dir, "COMMIT"), "w") as f:
+        f.write("ok")
+    trash = os.path.join(directory, f"_trash-step-{step}")
+    shutil.rmtree(trash, ignore_errors=True)
+    if os.path.isdir(final_dir):
+        os.rename(final_dir, trash)
+    os.rename(ckpt_dir, final_dir)
+    shutil.rmtree(trash, ignore_errors=True)
+    return Checkpoint(final_dir, step, snap["meta"]["metrics"])
+
+
+def _await_commit(final_dir: str, timeout: float) -> None:
+    """Non-zero async ranks resolve only once process 0's COMMIT is
+    visible — a resolved Checkpoint must always be restorable."""
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(os.path.join(final_dir, "COMMIT")):
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"no COMMIT at {final_dir} after "
+                               f"{timeout}s (rank-0 writer lost?)")
+        time.sleep(0.05)
+
+
+class AsyncCheckpointer:
+    """Orbax-style async multi-host saves (SURVEY §5.4): ``save`` pauses
+    training only for the device->host snapshot, then writes + commits
+    on a background thread; a kill mid-save leaves the previous
+    committed step restorable (no COMMIT until every rank's shards are
+    durable).
+
+        ckptr = AsyncCheckpointer()
+        fut = ckptr.save(directory, state, step)   # returns immediately
+        ...keep training...
+        ckpt = fut.result()                        # or ckptr.wait()
+    """
+
+    def __init__(self):
+        import concurrent.futures
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="async-ckpt")
+        self._inflight: Optional[Any] = None
+
+    def save(self, directory: str, state: Any, step: int,
+             metrics: Optional[Dict[str, Any]] = None):
+        """Snapshot now; write+commit in the background. Returns a
+        Future[Checkpoint]. Back-to-back saves serialize (one writer
+        thread), so at most one step of training overlaps a save."""
+        self.wait()  # surface a prior save's failure HERE, not silently
+        # On-thread (training-thread) prepare: clear + device barrier are
+        # safe here, between steps.
+        _prepare_save(directory, step)
+        snap = _snapshot(state, step, metrics)
+        self._inflight = self._pool.submit(_write_snapshot, directory,
+                                           snap)
+        return self._inflight
+
+    def wait(self) -> Optional[Checkpoint]:
+        """Block until the in-flight save (if any) committed."""
+        fut, self._inflight = self._inflight, None
+        return fut.result() if fut is not None else None
+
+    def close(self) -> None:
+        self.wait()
+        self._pool.shutdown(wait=True)
 
 
 def restore_checkpoint(ckpt: "Checkpoint | str", target: Any) -> Any:

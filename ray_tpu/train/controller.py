@@ -37,6 +37,14 @@ class TrainingFailedError(RuntimeError):
     pass
 
 
+class _ResizeRequested(Exception):
+    """Control-flow signal: the scaling policy wants a new world size."""
+
+    def __init__(self, target: int):
+        super().__init__(f"resize to {target} workers")
+        self.target = target
+
+
 class TrainController:
     def __init__(self, train_loop, train_loop_config: Optional[dict],
                  scaling_config: ScalingConfig, run_config: RunConfig,
@@ -50,6 +58,28 @@ class TrainController:
         self._datasets = dict(datasets or {})
         self._latest_checkpoint: Any = None
         self._metrics_history: List[Dict[str, Any]] = []
+        # World size is policy-owned: fixed by default, capacity-tracked
+        # when ScalingConfig.max_workers is set (reference: train/v2
+        # ScalingPolicy + controller.py:171 _execute_resize_decision).
+        from ray_tpu.train.scaling_policy import (ElasticScalingPolicy,
+                                                  FixedScalingPolicy)
+        if scaling_config.max_workers is not None:
+            self._policy = ElasticScalingPolicy(
+                scaling_config.min_workers or scaling_config.num_workers,
+                scaling_config.max_workers)
+        else:
+            self._policy = FixedScalingPolicy(scaling_config.num_workers)
+        self._world = scaling_config.num_workers
+        self._resize_pending = 0
+        self._resize_target = None
+        self._last_policy_check = 0.0
+        self._policy_err_logged = False
+        # Set while a resize attempt hasn't proven schedulable yet so a
+        # failed re-gang rolls back instead of burning failure budget;
+        # a rolled-back target is backed off for a while.
+        self._pre_resize_world: Optional[int] = None
+        self._failed_resize_target: Optional[int] = None
+        self._resize_backoff_until = 0.0
         # Top-K retention + auto-resume over the run's storage path
         # (reference: checkpoint_manager.py owned by the controller).
         self._ckpt_manager = None
@@ -67,11 +97,10 @@ class TrainController:
                 logger.info("auto-resuming from %s", latest)
                 self._latest_checkpoint = latest
 
-    def _make_shards(self) -> List[Dict[str, Any]]:
+    def _make_shards(self, n: int) -> List[Dict[str, Any]]:
         """streaming_split every dataset across the group; one fresh split
         per attempt (a restarted group must not resume half-consumed
         iterators). Returns per-rank {name: DataIterator}."""
-        n = self._scaling.num_workers
         per_rank: List[Dict[str, Any]] = [{} for _ in range(n)]
         self._coordinators: List[Any] = []
         for name, ds in self._datasets.items():
@@ -82,8 +111,7 @@ class TrainController:
         return per_rank
 
     # -- worker group lifecycle -----------------------------------------
-    def _make_group(self, pg):
-        n = self._scaling.num_workers
+    def _make_group(self, pg, n: int):
         if not pg.ready(timeout=120):
             raise TrainingFailedError(
                 f"could not reserve {n}x{self._scaling.bundle()} "
@@ -152,7 +180,28 @@ class TrainController:
                 result.metrics_history = self._metrics_history
                 result.checkpoint = self._latest_checkpoint
                 return result
+            except _ResizeRequested as r:
+                # Elastic resize is PROGRESS, not failure: re-gang at the
+                # new world size from the latest checkpoint without
+                # burning a failure budget (reference:
+                # controller.py:171 _execute_resize_decision).
+                logger.info("elastic resize: %d -> %d workers",
+                            self._world, r.target)
+                self._pre_resize_world = self._world
+                self._world = r.target
             except TrainingFailedError as e:
+                if self._pre_resize_world is not None:
+                    # The resized gang never became schedulable/healthy:
+                    # roll back to the size that WAS working instead of
+                    # burning the failure budget on an optimistic target.
+                    logger.warning(
+                        "resize to %d failed (%s); rolling back to %d",
+                        self._world, e, self._pre_resize_world)
+                    self._failed_resize_target = self._world
+                    self._resize_backoff_until = time.monotonic() + 60.0
+                    self._world = self._pre_resize_world
+                    self._pre_resize_world = None
+                    continue
                 last_error = e
                 attempt += 1
         return Result(metrics=(self._metrics_history[-1]
@@ -160,15 +209,51 @@ class TrainController:
                       metrics_history=self._metrics_history,
                       checkpoint=self._latest_checkpoint, error=last_error)
 
+    def _maybe_request_resize(self) -> None:
+        """Poll-loop hook: ask the policy for a target world size; two
+        consecutive IDENTICAL non-current answers trigger the resize
+        (debounce against node-state flaps); a target that just failed
+        to re-gang is backed off."""
+        now = time.monotonic()
+        if now - self._last_policy_check < 1.0:
+            return
+        self._last_policy_check = now
+        try:
+            target = self._policy.target_workers(
+                self._world, ray_tpu.nodes(), self._scaling.bundle())
+        except Exception:
+            if not self._policy_err_logged:
+                self._policy_err_logged = True
+                logger.warning("scaling policy check failed (elastic "
+                               "resize disabled until it recovers)",
+                               exc_info=True)
+            return
+        self._policy_err_logged = False
+        if target == self._world or target < 1 or (
+                target == self._failed_resize_target
+                and now < self._resize_backoff_until):
+            self._resize_pending = 0
+            self._resize_target = None
+            return
+        if target != self._resize_target:
+            self._resize_target = target
+            self._resize_pending = 1
+            return
+        self._resize_pending += 1
+        if self._resize_pending >= 2:
+            self._resize_pending = 0
+            self._resize_target = None
+            raise _ResizeRequested(target)
+
     def _run_attempt(self) -> Result:
-        n = self._scaling.num_workers
+        n = self._world
         pg = ray_tpu.placement_group(
             [self._scaling.bundle() for _ in range(n)],
             strategy=self._scaling.placement_strategy)
         workers: list = []
         try:
-            workers = self._make_group(pg)
-            shards = self._make_shards()
+            workers = self._make_group(pg, n)
+            shards = self._make_shards(n)
             starts = [
                 w.start.remote(
                     self._fn_blob, self._config,
@@ -177,8 +262,11 @@ class TrainController:
                     cloudpickle.dumps(shards[rank]))
                 for rank, w in enumerate(workers)]
             ray_tpu.get(starts, timeout=120)
+            # The (possibly resized) gang is live: later failures are
+            # real failures, not a bad resize target.
+            self._pre_resize_world = None
             return self._poll_until_done(workers)
-        except TrainingFailedError:
+        except (TrainingFailedError, _ResizeRequested):
             raise
         except Exception as e:
             raise TrainingFailedError(f"worker group failed: {e!r}") from e
@@ -222,5 +310,6 @@ class TrainController:
                 final = self._metrics_history[-1] \
                     if self._metrics_history else {}
                 return Result(metrics=final)
+            self._maybe_request_resize()
             time.sleep(poll_period)
             poll_period = min(poll_period * 1.5, 2.0)

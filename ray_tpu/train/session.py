@@ -76,8 +76,19 @@ def _start_session(ctx: TrainContext) -> _Session:
 
 
 def _end_session() -> None:
-    global _session
+    global _session, _async_ckptr
     _session = None
+    # Flush any in-flight async save: the worker reporting "finished"
+    # (and getting killed) must not strand an uncommitted checkpoint.
+    ckptr, _async_ckptr = _async_ckptr, None
+    if ckptr is not None:
+        try:
+            ckptr.close()
+        except Exception:
+            from ray_tpu.utils import get_logger
+            get_logger("train.session").warning(
+                "async checkpoint flush at session end failed",
+                exc_info=True)
 
 
 def get_context() -> TrainContext:
@@ -120,17 +131,33 @@ def profile():
 
 
 def save_checkpoint(state: Any, step: int,
-                    metrics: Optional[Dict[str, Any]] = None):
+                    metrics: Optional[Dict[str, Any]] = None, *,
+                    block: bool = True):
     """Sharded save of a jax pytree into the run's storage path; call from
     EVERY rank (per-host shard writes + commit barrier), then report the
-    returned handle: ``report(metrics, checkpoint=save_checkpoint(...))``."""
+    returned handle: ``report(metrics, checkpoint=save_checkpoint(...))``.
+
+    block=False (async, SURVEY §5.4 Orbax pattern): only the
+    device->host snapshot runs here; file writes + the commit barrier
+    run on a background thread and a Future[Checkpoint] is returned —
+    call ``.result()`` (or save again, which serializes) before
+    reporting it."""
     from ray_tpu.train.checkpointing import run_dir
     from ray_tpu.train.checkpointing import save_checkpoint as _save
     ctx = get_context()
     if not ctx.storage_path:
         raise RuntimeError("RunConfig.storage_path is not set")
-    return _save(run_dir(ctx.storage_path, ctx.experiment_name), state,
-                 step, metrics)
+    directory = run_dir(ctx.storage_path, ctx.experiment_name)
+    if block:
+        return _save(directory, state, step, metrics)
+    global _async_ckptr
+    if _async_ckptr is None:
+        from ray_tpu.train.checkpointing import AsyncCheckpointer
+        _async_ckptr = AsyncCheckpointer()
+    return _async_ckptr.save(directory, state, step, metrics)
+
+
+_async_ckptr = None
 
 
 def report(metrics: Dict[str, Any], checkpoint: Optional[Any] = None) -> None:

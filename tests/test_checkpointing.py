@@ -7,6 +7,7 @@ per-host shard writes + commit barrier) on the virtual 8-device CPU mesh.
 """
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -191,5 +192,125 @@ def test_profile_captures_trace(tmp_path):
         for root, _dirs, files in os.walk(out):
             found.extend(files)
         assert found, f"no trace files under {out}"
+    finally:
+        sess._end_session()
+
+
+def test_async_save_overlaps_training(tmp_path, monkeypatch):
+    """AsyncCheckpointer: save() returns after the device->host snapshot;
+    the write + commit happen in the background while 'training'
+    continues (SURVEY §5.4 Orbax async pattern)."""
+    import threading
+
+    import numpy as _np
+
+    from ray_tpu.train import checkpointing as C
+
+    gate = threading.Event()
+
+    class SlowNP:
+        def __getattr__(self, name):
+            return getattr(_np, name)
+
+        def save(self, *a, **kw):
+            gate.wait(timeout=60)  # writes stall until the test releases
+            return _np.save(*a, **kw)
+
+    state = _sharded_state()
+    ckptr = C.AsyncCheckpointer()
+    monkeypatch.setattr(C, "np", SlowNP())
+    try:
+        fut = ckptr.save(str(tmp_path), state, step=1)
+        # Returned BEFORE any file write finished: nothing committed yet.
+        assert not fut.done()
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "step-1", "COMMIT"))
+        # "training" continues on this thread while the writer is stuck.
+        acc = sum(range(1000))
+        assert acc == 499500
+        gate.set()
+        ckpt = fut.result(timeout=60)
+        assert ckpt.is_valid()
+    finally:
+        gate.set()
+        monkeypatch.setattr(C, "np", _np)
+        ckptr.close()
+    restored = restore_checkpoint(ckpt, state)
+    np.testing.assert_array_equal(np.asarray(restored["layer"]["w"]),
+                                  np.arange(64.0).reshape(8, 8))
+
+
+def test_kill_mid_async_save_keeps_previous_commit(tmp_path):
+    """A save that never completes (crash mid-write) leaves NO COMMIT for
+    its step; the previous committed step stays the restore point."""
+    from ray_tpu.train import checkpointing as C
+
+    state = _sharded_state()
+    prev = save_checkpoint(str(tmp_path), state, step=1)
+    assert prev.is_valid()
+
+    # Simulate the crash: snapshot taken, some files written, no commit.
+    snap = C._snapshot(state, 2, None)
+    tmp2 = os.path.join(str(tmp_path), "_tmp-step-2")
+    os.makedirs(tmp2)
+    fname, arr = snap["writes"][0]
+    np.save(os.path.join(tmp2, fname), arr)
+    # (process dies here)
+
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.latest().step == 1
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(os.path.join(str(tmp_path), "step-2"), state)
+    restored = restore_checkpoint(mgr.latest(), state)
+    assert int(restored["step"]) == 7
+
+
+def test_async_marker_barrier_multiprocess(tmp_path):
+    """The async commit barrier is rank marker files: process 0 commits
+    only after EVERY rank's writes are durable (no device collectives on
+    the writer thread)."""
+    import threading
+
+    from ray_tpu.train import checkpointing as C
+
+    state = _sharded_state()
+    snap = C._snapshot(state, 3, {"loss": 1.0})
+    snap0 = {**snap, "proc": 0, "nprocs": 2}
+    snap1 = {**snap, "proc": 1, "nprocs": 2, "writes": []}
+
+    out = {}
+
+    def rank0():
+        out["ckpt"] = C._write_snapshot(str(tmp_path), snap0,
+                                        barrier_timeout=60)
+
+    t = threading.Thread(target=rank0)
+    t.start()
+    time.sleep(0.5)
+    # Rank 1 hasn't arrived: no commit yet.
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "step-3", "COMMIT"))
+    assert t.is_alive()
+    C._write_snapshot(str(tmp_path), snap1)
+    t.join(timeout=60)
+    assert out["ckpt"].is_valid()
+    assert out["ckpt"].metrics == {"loss": 1.0}
+
+
+def test_session_async_save(tmp_path):
+    """ray_tpu.train.save_checkpoint(block=False) returns a
+    Future[Checkpoint] through the worker session."""
+    from ray_tpu.train import session as sess
+
+    ctx = sess.TrainContext(0, 1, "async_sess", str(tmp_path))
+    sess._start_session(ctx)
+    try:
+        state = {"x": np.arange(4.0)}
+        fut = sess.save_checkpoint(state, 0, block=False)
+        ckpt = fut.result(timeout=60)
+        assert ckpt.is_valid() and ckpt.step == 0
+        # A second async save serializes behind the first and lands too.
+        fut2 = sess.save_checkpoint(state, 1, block=False)
+        assert fut2.result(timeout=60).step == 1
     finally:
         sess._end_session()
