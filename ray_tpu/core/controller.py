@@ -374,6 +374,7 @@ class Controller:
     async def _health_loop(self) -> None:
         period = GlobalConfig.health_check_period_ms / 1000
         timeout = GlobalConfig.health_check_timeout_ms / 1000
+        last_reconcile = time.monotonic()
         while True:
             await asyncio.sleep(period)
             cutoff = time.monotonic() - timeout
@@ -381,6 +382,31 @@ class Controller:
                 if node.state == NodeState.ALIVE and node.last_heartbeat < cutoff:
                     await self._mark_node_dead(node.node_id,
                                                "health check timeout")
+            if time.monotonic() - last_reconcile > 10.0:
+                last_reconcile = time.monotonic()
+                await self._reconcile_bundles()
+
+    async def _reconcile_bundles(self) -> None:
+        """Release ORPHANED bundle reservations on agents: a controller
+        death between prepare and commit leaves the agent holding
+        resources for a PG placement the restored controller re-plans
+        elsewhere (reference: gcs_placement_group_scheduler.cc handles
+        this with leasing epochs; here the source of truth is the
+        controller's CREATED bundle_nodes + in-flight PENDING ids)."""
+        pending = {pg.pg_id for pg in self.pgs.values()
+                   if pg.state == PGState.PENDING}
+        valid: Dict[bytes, list] = {}
+        for pg in self.pgs.values():
+            for i, node_id in enumerate(pg.bundle_nodes):
+                if node_id:
+                    valid.setdefault(node_id, []).append((pg.pg_id, i))
+        for node in self._alive_nodes():
+            try:
+                await node.client.call(
+                    "reconcile_bundles", valid.get(node.node_id, []),
+                    list(pending))
+            except Exception:
+                pass  # unreachable node: the health check handles it
 
     # ------------------------------------------------------------------
     # scheduling policy (hybrid pack-then-spread, reference:

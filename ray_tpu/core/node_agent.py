@@ -194,6 +194,7 @@ class NodeAgent:
         self._lease_seq = 0
         # pg_id -> bundle_index -> resources (prepared or committed)
         self.bundles: Dict[bytes, Dict[int, Dict[str, float]]] = {}
+        self._bundle_prepared_at: Dict[tuple, float] = {}
         self.bundle_available: Dict[Tuple[bytes, int], Dict[str, float]] = {}
         self._peer_clients: Dict[Address, RpcClient] = {}
         self._resource_cv = asyncio.Condition()
@@ -773,9 +774,16 @@ class NodeAgent:
     # ------------------------------------------------------------------
     async def prepare_bundle(self, pg_id: bytes, index: int,
                              resources: dict) -> bool:
+        # Idempotent: a restored controller re-driving a PENDING PG may
+        # re-prepare a bundle this agent already holds from before the
+        # restart — re-subtracting would leak resources (and the held
+        # reservation would block its own retry).
+        if index in self.bundles.get(pg_id, {}):
+            return True
         if resources_fit(self.resources_available, resources):
             resources_sub(self.resources_available, resources)
             self.bundles.setdefault(pg_id, {})[index] = dict(resources)
+            self._bundle_prepared_at[(pg_id, index)] = time.monotonic()
             return True
         return False
 
@@ -788,9 +796,39 @@ class NodeAgent:
 
     async def return_bundle(self, pg_id: bytes, index: int) -> None:
         res = self.bundles.get(pg_id, {}).pop(index, None)
+        self._bundle_prepared_at.pop((pg_id, index), None)
         if res is not None:
             self.bundle_available.pop((pg_id, index), None)
             await self._free_resources(res)
+
+    # Reservations younger than this never reconcile away: the
+    # controller's valid/pending sets are a snapshot and a prepare can
+    # land between snapshot and this RPC (TOCTOU).
+    _BUNDLE_RECONCILE_GRACE_S = 30.0
+
+    async def reconcile_bundles(self, valid_pairs: list,
+                                pending_pg_ids: list) -> None:
+        """Drop reservations the controller no longer recognizes (its
+        2-phase commit placed the PG elsewhere, or the PG is gone) —
+        reservations of still-PENDING PGs, and any prepared within the
+        grace window, are left for the in-flight prepare/commit to
+        settle."""
+        valid = {(bytes(p), int(i)) for p, i in valid_pairs}
+        pending = {bytes(p) for p in pending_pg_ids}
+        now = time.monotonic()
+        for pg_id in list(self.bundles):
+            if pg_id in pending:
+                continue
+            for index in list(self.bundles.get(pg_id, {})):
+                if (pg_id, index) in valid:
+                    continue
+                prepared_at = self._bundle_prepared_at.get(
+                    (pg_id, index), now)
+                if now - prepared_at < self._BUNDLE_RECONCILE_GRACE_S:
+                    continue
+                logger.info("reconcile: releasing orphaned bundle "
+                            "(%s, %d)", pg_id.hex()[:8], index)
+                await self.return_bundle(pg_id, index)
 
     # ------------------------------------------------------------------
     # actors

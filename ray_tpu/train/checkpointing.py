@@ -124,7 +124,9 @@ def save_checkpoint(directory: str, state: Any, step: int,
     import jax
 
     _prepare_save(directory, step)
-    snap = _snapshot(state, step, metrics)
+    # No host copies on the sync path: nothing overlaps the write, so
+    # shards stream zero-copy (async saves must copy — see _snapshot).
+    snap = _snapshot(state, step, metrics, copy=False)
     ckpt = _write_snapshot(directory, snap, device_barrier=True)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
@@ -148,12 +150,15 @@ def _prepare_save(directory: str, step: int) -> None:
 
 
 def _snapshot(state: Any, step: int,
-              metrics: Optional[Dict[str, Any]]) -> dict:
+              metrics: Optional[Dict[str, Any]],
+              copy: bool = True) -> dict:
     """Device->host snapshot + metadata plan — the ONLY phase that must
     pause the training loop (HBM->RAM copies of this process's replica-0
-    shards). Arrays are COPIED: on backends where __array__ is zero-copy
-    (CPU), a donated buffer would otherwise be reused by the next train
-    step while a background writer still reads it."""
+    shards). With copy=True (the ASYNC path) arrays are deep-copied: on
+    backends where __array__ is zero-copy (CPU), a donated buffer would
+    otherwise be reused by the next train step while the background
+    writer still reads it. The sync path passes copy=False and streams
+    shards without doubling host memory."""
     import jax
 
     proc = jax.process_index()
@@ -167,8 +172,12 @@ def _snapshot(state: Any, step: int,
             for shard in leaf.addressable_shards:
                 if shard.replica_id == 0:
                     key = _index_key(shard.index, shape)
-                    writes.append((f"leaf{li}.{key}.npy",
-                                   np.array(shard.data, copy=True)))
+                    # asarray (copy only if the backend must) for sync;
+                    # forced copy for async (numpy 2 rejects copy=False
+                    # when a device->host copy is unavoidable).
+                    host = np.array(shard.data, copy=True) if copy \
+                        else np.asarray(shard.data)
+                    writes.append((f"leaf{li}.{key}.npy", host))
             # Manifest: the exact global shard-key set (computable on any
             # process from the global sharding) — readers trust only
             # these files, so stale shards from a crashed save are never
@@ -183,7 +192,8 @@ def _snapshot(state: Any, step: int,
         else:
             if proc == 0:
                 writes.append((f"leaf{li}.host.npy",
-                               np.array(leaf, copy=True)))
+                               np.array(leaf, copy=True) if copy
+                               else np.asarray(leaf)))
             meta["leaves"].append({"name": name, "kind": "host",
                                    "shape": tuple(np.shape(leaf)),
                                    "dtype": str(np.asarray(leaf).dtype),
@@ -231,7 +241,7 @@ def _write_snapshot(directory: str, snap: dict,
                 f.write("ok")
     if proc != 0:
         if not device_barrier:
-            _await_commit(final_dir, barrier_timeout)
+            _await_commit(final_dir, ckpt_dir, proc, barrier_timeout)
         return Checkpoint(final_dir, step, snap["meta"]["metrics"])
     if nprocs > 1 and not device_barrier:
         deadline = time.monotonic() + barrier_timeout
@@ -260,10 +270,22 @@ def _write_snapshot(directory: str, snap: dict,
     return Checkpoint(final_dir, step, snap["meta"]["metrics"])
 
 
-def _await_commit(final_dir: str, timeout: float) -> None:
-    """Non-zero async ranks resolve only once process 0's COMMIT is
-    visible — a resolved Checkpoint must always be restorable."""
+def _await_commit(final_dir: str, ckpt_dir: str, proc: int,
+                  timeout: float) -> None:
+    """Non-zero async ranks resolve only once THIS save committed — a
+    resolved Checkpoint must always be restorable. A pre-existing
+    committed step-N (re-save of an old step) must not satisfy the wait,
+    so first wait for rank 0 to consume OUR marker file (it unlinks all
+    markers immediately before writing COMMIT; the residual
+    crash-between-unlink-and-commit window is microseconds vs the whole
+    write window)."""
+    marker = os.path.join(ckpt_dir, f"_rank-{proc}.done")
     deadline = time.monotonic() + timeout
+    while os.path.exists(marker):
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"commit barrier: rank-0 never consumed "
+                               f"{marker} within {timeout}s")
+        time.sleep(0.05)
     while not os.path.exists(os.path.join(final_dir, "COMMIT")):
         if time.monotonic() > deadline:
             raise TimeoutError(f"no COMMIT at {final_dir} after "

@@ -246,6 +246,23 @@ class TrainController:
             raise _ResizeRequested(target)
 
     def _run_attempt(self) -> Result:
+        # Attempt-start policy check (no debounce): after a FAILURE the
+        # poll loop never saw the capacity change — a node loss must
+        # shrink the re-gang here instead of wedging on an unreservable
+        # world size (the healthy-path growth stays debounced in
+        # _maybe_request_resize).
+        try:
+            target = self._policy.target_workers(
+                self._world, ray_tpu.nodes(), self._scaling.bundle())
+            if (target >= 1 and target != self._world
+                    and not (target == self._failed_resize_target
+                             and time.monotonic()
+                             < self._resize_backoff_until)):
+                logger.info("attempt-start resize: %d -> %d workers",
+                            self._world, target)
+                self._world = target
+        except Exception:
+            pass
         n = self._world
         pg = ray_tpu.placement_group(
             [self._scaling.bundle() for _ in range(n)],
