@@ -203,7 +203,7 @@ def test_elastic_resize_grows_mid_run(tmp_path):
                 host = rt.load_checkpoint_host(prev)
                 start_step = int(host["step"]) + 1
                 w = jnp.asarray(host["w"])
-            for step in range(start_step, 16):
+            for step in range(start_step, 20):
                 w = w + 1.0
                 _t.sleep(0.5)  # slow enough for the resize to land
                 ckpt = rt.save_checkpoint({"w": w, "step": step}, step)
@@ -226,10 +226,10 @@ def test_elastic_resize_grows_mid_run(tmp_path):
             run = os.path.join(storage, "elastic")
             deadline = time.time() + 60
             while time.time() < deadline:
-                if os.path.exists(os.path.join(run, "step-1", "COMMIT")):
+                if os.path.exists(os.path.join(run, "step-0", "COMMIT")):
                     c.add_node(resources={"CPU": 1})
                     return
-                time.sleep(0.1)
+                time.sleep(0.05)
 
         t = threading.Thread(target=join_later)
         t.start()
@@ -244,9 +244,9 @@ def test_elastic_resize_grows_mid_run(tmp_path):
         # The post-resize attempt resumed from a checkpoint, not step 0.
         resumed = [m for m in hist if m["world"] == 2]
         assert resumed[0]["resumed_from"] > 0, resumed[:2]
-        assert hist[-1]["step"] == 15
+        assert hist[-1]["step"] == 19
         # Progress accumulated across the resize: w0 == step + 1.
-        assert hist[-1]["w0"] == 16.0
+        assert hist[-1]["w0"] == 20.0
 
         # Policy unit sanity: growth uses AVAILABLE resources, shrink
         # uses TOTAL; dead nodes count for neither.
