@@ -23,12 +23,13 @@ from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      PBTScheduler)
 from ray_tpu.tune.search import (BasicVariantGenerator, Searcher, choice,
                                  grid_search, loguniform, randint, uniform)
+from ray_tpu.tune.tpe import TPESearcher
 from ray_tpu.tune.trial import get_checkpoint, report
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
 
 __all__ = [
     "ASHAScheduler", "BasicVariantGenerator", "FIFOScheduler",
-    "PBTScheduler", "ResultGrid", "Searcher", "TrialResult", "TuneConfig",
-    "Tuner", "choice", "get_checkpoint", "grid_search", "loguniform",
-    "randint", "report", "uniform",
+    "PBTScheduler", "ResultGrid", "Searcher", "TPESearcher", "TrialResult",
+    "TuneConfig", "Tuner", "choice", "get_checkpoint", "grid_search",
+    "loguniform", "randint", "report", "uniform",
 ]

@@ -122,6 +122,13 @@ class Searcher:
                           error: bool = False) -> None:
         pass
 
+    def on_trial_restore(self, trial_id: str,
+                         config: Dict[str, Any]) -> None:
+        """A restored (re-run) trial is back in flight with `config`:
+        adaptive searchers re-register it so its eventual completion is
+        attributable (Tuner.restore path)."""
+        pass
+
 
 class BasicVariantGenerator(Searcher):
     """Random/grid sampling over a param space — the default search

@@ -83,34 +83,141 @@ class ResultGrid:
 class Tuner:
     def __init__(self, trainable: Callable[[dict], Any], *,
                  param_space: Optional[Dict[str, Any]] = None,
-                 tune_config: Optional[TuneConfig] = None):
+                 tune_config: Optional[TuneConfig] = None,
+                 storage_path: Optional[str] = None,
+                 name: str = "tune_run"):
+        """storage_path: persist experiment state (trial table + searcher
+        state) under storage_path/name after every trial completion —
+        Tuner.restore() resumes an interrupted run from it (reference:
+        tune/execution/experiment_state.py + Tuner.restore)."""
         self._fn_blob = cloudpickle.dumps(trainable)
         self._space = param_space or {}
         self._cfg = tune_config or TuneConfig()
+        self._storage = storage_path
+        self._name = name
+        self._restored_trials: List[TrialResult] = []
+        self._restart_errored = False
+
+    @property
+    def experiment_path(self) -> Optional[str]:
+        import os
+        if not self._storage:
+            return None
+        return os.path.join(self._storage, self._name)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable[[dict], Any], *,
+                restart_errored: bool = False) -> "Tuner":
+        """Resume an interrupted experiment from its state file
+        (reference: Tuner.restore). Completed trials keep their results;
+        pending/interrupted trials re-run; errored trials re-run only
+        with restart_errored=True. The searcher resumes with everything
+        it had learned."""
+        import os
+        import pickle
+        with open(os.path.join(path, "experiment_state.pkl"), "rb") as f:
+            st = pickle.load(f)
+        tuner = cls(trainable,
+                    param_space=cloudpickle.loads(st["space_blob"]),
+                    tune_config=cloudpickle.loads(st["cfg_blob"]),
+                    storage_path=os.path.dirname(os.path.abspath(path)),
+                    name=os.path.basename(os.path.abspath(path)))
+        tuner._restored_trials = [
+            TrialResult(**rec) for rec in st["trials"]]
+        tuner._restart_errored = restart_errored
+        return tuner
+
+    def _save_state(self, trials: List[TrialResult]) -> None:
+        import os
+        import pickle
+        path = self.experiment_path
+        if not path:
+            return
+        os.makedirs(path, exist_ok=True)
+        # cfg_blob captures the searcher/scheduler OBJECTS — including
+        # everything an adaptive searcher learned so far.
+        st = {
+            "space_blob": cloudpickle.dumps(self._space),
+            "cfg_blob": cloudpickle.dumps(self._cfg),
+            "trials": [{
+                "trial_id": t.trial_id, "config": t.config,
+                "metrics": t.metrics,
+                "metrics_history": t.metrics_history,
+                "status": t.status, "error": t.error,
+                "iterations": t.iterations,
+            } for t in trials],
+        }
+        tmp = os.path.join(path, "experiment_state.pkl.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(st, f)
+        os.replace(tmp, os.path.join(path, "experiment_state.pkl"))
 
     def fit(self) -> ResultGrid:
         cfg = self._cfg
         scheduler = cfg.scheduler or FIFOScheduler()
         if getattr(scheduler, "metric", "x") is None:
             scheduler.metric = cfg.metric
-        if cfg.search_alg is not None:
-            # Searcher seam (reference: search/searcher.py): the search
-            # algorithm proposes each trial's config.
-            variants = []
-            for i in range(cfg.num_samples):
-                v = cfg.search_alg.suggest(f"trial_{i:05d}")
-                if v is None:
-                    break
-                variants.append(v)
+
+        # Restored trial table: finished trials keep their results;
+        # interrupted (and optionally errored) ones re-run.
+        trials: List[TrialResult] = list(self._restored_trials)
+        rerun: List[TrialResult] = []
+        for t in trials:
+            if t.status in (TERMINATED, STOPPED):
+                continue
+            if t.status == ERROR and not self._restart_errored:
+                continue
+            t.status = PENDING
+            t.error = None
+            t.metrics = {}
+            t.metrics_history = []
+            t.iterations = 0
+            rerun.append(t)
+        next_index = len(trials)
+
+        # Variant source: the searcher proposes LAZILY (one config per
+        # launch slot, so completions can inform later suggestions —
+        # reference: SearchGenerator), the default generator is a
+        # precomputed sequence.
+        if cfg.search_alg is None:
+            # Same seed -> same sequence: skip the variants the restored
+            # trials (completed AND re-queued) already consumed.
+            seq = iter(list(generate_variants(
+                self._space, cfg.num_samples, cfg.seed))[next_index:])
+
+            def next_variant(trial_id: str):
+                return next(seq, None)
         else:
-            variants = list(generate_variants(self._space, cfg.num_samples,
-                                              cfg.seed))
-        trials = [TrialResult(trial_id=f"trial_{i:05d}", config=v)
-                  for i, v in enumerate(variants)]
-        if hasattr(scheduler, "track"):  # PBT needs live configs
-            for t in trials:
+            def next_variant(trial_id: str):
+                return cfg.search_alg.suggest(trial_id)
+
+        def launch_next() -> Optional[TrialResult]:
+            nonlocal next_index
+            if rerun:
+                t = rerun.pop(0)
+                if cfg.search_alg is not None:
+                    # Re-register so the searcher attributes the re-run's
+                    # completion (its pending entry died with phase 1).
+                    cfg.search_alg.on_trial_restore(t.trial_id, t.config)
+                return t
+            # Searcher runs are capped at num_samples trials; the
+            # default generator's sequence bounds itself (num_samples
+            # MULTIPLIES the grid there, reference semantics).
+            if cfg.search_alg is not None \
+                    and next_index >= cfg.num_samples:
+                return None
+            tid = f"trial_{next_index:05d}"
+            v = next_variant(tid)
+            if v is None:
+                return None
+            t = TrialResult(trial_id=tid, config=v)
+            next_index += 1
+            trials.append(t)
+            by_id[t.trial_id] = t
+            if hasattr(scheduler, "track"):  # PBT needs live configs
                 scheduler.track(t.trial_id, t.config)
-        pending = list(trials)
+            return t
+
         running: Dict[str, Any] = {}   # trial_id -> actor handle
         stopping: set = set()
         actor_cls = ray_tpu.remote(TrialRunner)
@@ -127,12 +234,21 @@ class Tuner:
             actor_cls = actor_cls.options(**opts)
 
         by_id = {t.trial_id: t for t in trials}
-        while pending or running:
-            while pending and len(running) < cfg.max_concurrent_trials:
-                t = pending.pop(0)
+        if hasattr(scheduler, "track"):
+            for t in rerun:
+                scheduler.track(t.trial_id, t.config)
+        exhausted = False
+        while True:
+            while not exhausted and len(running) < cfg.max_concurrent_trials:
+                t = launch_next()
+                if t is None:
+                    exhausted = True
+                    break
                 t.status = RUNNING
                 running[t.trial_id] = actor_cls.remote(self._fn_blob,
                                                        t.config)
+            if not running and exhausted:
+                break
             done: List[str] = []
             for tid, actor in running.items():
                 t = by_id[tid]
@@ -194,14 +310,22 @@ class Tuner:
                     ray_tpu.kill(actor)
                 except Exception:
                     pass
+                t = by_id[tid]
+                # Completions feed the searcher IMMEDIATELY so later
+                # suggestions learn from them (reference: SearchGenerator
+                # on_trial_complete).
+                if cfg.search_alg is not None:
+                    cfg.search_alg.on_trial_complete(
+                        tid, t.metrics or None, error=t.status == ERROR)
+            if done:
+                # One snapshot per poll round (it serializes the whole
+                # trial table + searcher state).
+                self._save_state(trials)
             if running:
                 time.sleep(0.2)
-        if cfg.search_alg is not None:
-            for t in trials:
-                cfg.search_alg.on_trial_complete(
-                    t.trial_id, t.metrics or None, error=t.status == ERROR)
         logger.info("tune finished: %d trials (%d errors)", len(trials),
                     sum(1 for t in trials if t.status == ERROR))
+        self._save_state(trials)
         return ResultGrid(trials, cfg.metric, cfg.mode)
 
     def _exploit(self, actor_cls, running, by_id, tid: str,

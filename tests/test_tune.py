@@ -157,3 +157,78 @@ def test_searcher_seam(cluster):
     assert len(grid) == 4
     assert grid.get_best_result().config == {"x": 2}
     assert len(searcher.completed) == 4
+
+
+def test_tpe_converges_beyond_random(cluster):
+    """TPE (reference: search/hyperopt TPE family): after the random
+    warmup, proposals concentrate near the optimum — the best result
+    beats the warmup phase's best on a deterministic quadratic."""
+    def trainable(config):
+        loss = (config["x"] - 0.7) ** 2 + (config["y"] - 3.0) ** 2 / 25.0
+        tune.report({"loss": loss})
+
+    searcher = tune.TPESearcher(
+        {"x": tune.uniform(0.0, 5.0), "y": tune.loguniform(0.1, 100.0)},
+        metric="loss", mode="min", n_initial=8, seed=7)
+    grid = tune.Tuner(
+        trainable,
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    num_samples=32, search_alg=searcher,
+                                    max_concurrent_trials=1),
+    ).fit()
+    assert len(grid) == 32 and grid.num_errors() == 0
+    results = grid.results
+    warmup_best = min(r.metrics["loss"] for r in results[:8])
+    overall_best = grid.get_best_result().metrics["loss"]
+    assert overall_best <= warmup_best, (overall_best, warmup_best)
+    assert overall_best < 0.5, f"TPE never got close: {overall_best}"
+    # The learned phase concentrates: its median beats the warmup median.
+    import statistics
+    warm = statistics.median(r.metrics["loss"] for r in results[:8])
+    late = statistics.median(r.metrics["loss"] for r in results[16:])
+    assert late < warm, (late, warm)
+
+
+def test_tuner_restore_resumes_interrupted_run(cluster, tmp_path):
+    """Tuner.restore (reference: tune/execution/experiment_state.py):
+    an interrupted experiment resumes — completed trials keep their
+    results (not re-executed), failed/unfinished ones re-run."""
+    import os
+
+    marker_dir = str(tmp_path / "runs")
+    os.makedirs(marker_dir)
+    flag = str(tmp_path / "phase2")
+
+    def trainable(config):
+        import os as _os
+        i = config["i"]
+        # Count executions per variant across both phases.
+        with open(_os.path.join(config["marker_dir"], f"run-{i}"),
+                  "a") as f:
+            f.write("x")
+        if i >= 3 and not _os.path.exists(config["flag"]):
+            raise RuntimeError("simulated interruption")  # phase 1 only
+        tune.report({"loss": float(i)})
+
+    space = {"i": tune.grid_search([0, 1, 2, 3, 4, 5]),
+             "marker_dir": marker_dir, "flag": flag}
+    storage = str(tmp_path / "exp")
+    t1 = tune.Tuner(trainable, param_space=space,
+                    tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                                num_samples=1, seed=3),
+                    storage_path=storage, name="resume_me")
+    g1 = t1.fit()
+    assert g1.num_errors() == 3  # trials 3..5 "interrupted"
+
+    # Phase 2: restore and re-run only the failed trials.
+    open(flag, "w").close()
+    t2 = tune.Tuner.restore(os.path.join(storage, "resume_me"),
+                            trainable, restart_errored=True)
+    g2 = t2.fit()
+    assert len(g2) == 6 and g2.num_errors() == 0
+    losses = sorted(r.metrics["loss"] for r in g2.results)
+    assert losses == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+    # Completed trials were NOT re-executed; failed ones ran twice.
+    for i in range(6):
+        runs = len(open(os.path.join(marker_dir, f"run-{i}")).read())
+        assert runs == (2 if i >= 3 else 1), (i, runs)
