@@ -14,12 +14,13 @@ actors and a jitted JAX learner.
         print(algo.train()["episode_return_mean"])
 """
 
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, Env
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
-from ray_tpu.rllib.learner import IMPALALearner, PPOLearner
+from ray_tpu.rllib.learner import DQNLearner, IMPALALearner, PPOLearner
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay import PrioritizedReplayBuffer, ReplayBuffer
 
-__all__ = ["CartPole", "Env", "IMPALA", "IMPALAConfig", "IMPALALearner",
-           "PPO", "PPOConfig", "PPOLearner", "PrioritizedReplayBuffer",
-           "ReplayBuffer"]
+__all__ = ["CartPole", "DQN", "DQNConfig", "DQNLearner", "Env", "IMPALA",
+           "IMPALAConfig", "IMPALALearner", "PPO", "PPOConfig",
+           "PPOLearner", "PrioritizedReplayBuffer", "ReplayBuffer"]

@@ -43,13 +43,26 @@ class EnvRunner:
         self._weights = weights
         return True
 
-    def _rollout(self, num_steps: int) -> Dict[str, np.ndarray]:
+    def _policy_action(self, obs: np.ndarray) -> tuple:
+        """Default behavior: sample from the softmax policy head."""
+        logp = _log_softmax(_np_forward(self._weights["pi"],
+                                        obs[None, :]))[0]
+        action = int(self._rng.choice(len(logp), p=np.exp(logp)))
+        return action, float(logp[action])
+
+    def _rollout(self, num_steps: int,
+                 select_action=None) -> Dict[str, np.ndarray]:
         """Shared stepping loop: behavior-policy transitions with explicit
-        term/trunc flags and the final pre-reset obs at truncations —
-        using the next episode's reset obs would leak value estimates
-        across episode boundaries (both GAE and V-trace need this)."""
+        term/trunc flags, per-step next obs, and the final pre-reset obs
+        at truncations — using the next episode's reset obs would leak
+        value estimates across episode boundaries (GAE, V-trace, and TD
+        targets all need this). `select_action(obs) -> (action, logp)`
+        swaps the behavior policy (epsilon-greedy Q for DQN)."""
+        select_action = select_action or self._policy_action
         obs_buf = np.zeros((num_steps, self._env.observation_size),
                            np.float32)
+        next_buf = np.zeros((num_steps, self._env.observation_size),
+                            np.float32)
         act_buf = np.zeros(num_steps, np.int32)
         rew_buf = np.zeros(num_steps, np.float32)
         term_buf = np.zeros(num_steps, np.float32)
@@ -58,17 +71,16 @@ class EnvRunner:
         trunc_obs = np.zeros((num_steps, self._env.observation_size),
                              np.float32)
 
-        pi = self._weights["pi"]
         self._completed_returns = []
         obs = self._obs
         for t in range(num_steps):
-            logp = _log_softmax(_np_forward(pi, obs[None, :]))[0]
-            action = int(self._rng.choice(len(logp), p=np.exp(logp)))
+            action, logp_a = select_action(obs)
             nxt, rew, term, trunc, _ = self._env.step(action)
             obs_buf[t] = obs
+            next_buf[t] = nxt
             act_buf[t] = action
             rew_buf[t] = rew
-            logp_buf[t] = logp[action]
+            logp_buf[t] = logp_a
             term_buf[t] = float(term)
             trunc_buf[t] = float(trunc and not term)
             if trunc and not term:
@@ -84,6 +96,7 @@ class EnvRunner:
         self._obs = obs
         return {
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "next_obs": next_buf,
             "terms": term_buf, "truncs": trunc_buf,
             "trunc_obs": trunc_obs, "behavior_logp": logp_buf,
             "bootstrap_obs": obs.astype(np.float32),
@@ -137,4 +150,28 @@ class EnvRunner:
         log-probs, NO advantage computation (the learner applies V-trace
         off-policy correction; reference:
         rllib/algorithms/impala/impala.py async sample batches)."""
-        return self._rollout(num_steps)
+        roll = self._rollout(num_steps)
+        roll.pop("next_obs", None)  # V-trace never reads per-step next
+        return roll
+
+    def sample_transitions(self, num_steps: int,
+                           epsilon: float) -> Dict[str, np.ndarray]:
+        """Off-policy transition collection with epsilon-greedy Q actions
+        (reference: DQN env runners + EpsilonGreedy exploration).
+        Truncations count as NON-terminal (the TD target bootstraps
+        through them); `next_obs` at a boundary is the final pre-reset
+        obs (the shared _rollout loop guarantees this)."""
+        q = self._weights["q"]
+
+        def select(obs):
+            if self._rng.random_sample() < epsilon:
+                return int(self._rng.randint(self._env.num_actions)), 0.0
+            return int(np.argmax(_np_forward(q, obs[None, :])[0])), 0.0
+
+        roll = self._rollout(num_steps, select)
+        return {
+            "obs": roll["obs"], "actions": roll["actions"],
+            "rewards": roll["rewards"], "next_obs": roll["next_obs"],
+            "dones": roll["terms"],
+            "episode_returns": roll["episode_returns"],
+        }

@@ -216,3 +216,84 @@ class IMPALALearner:
         self.params, self._opt_state, aux = self._update(
             self.params, self._opt_state, dev)
         return {k: float(v) for k, v in aux.items()}
+
+
+class DQNLearner:
+    """Double-DQN with a target network and per-sample TD errors for
+    prioritized replay (reference: rllib/algorithms/dqn/
+    dqn_rainbow_torch_learner.py loss — double-Q action selection from
+    the ONLINE net, evaluation from the TARGET net; Huber TD loss
+    weighted by importance-sampling weights)."""
+
+    def __init__(self, obs_size: int, num_actions: int, *,
+                 hidden: Tuple[int, ...] = (64, 64), lr: float = 1e-3,
+                 gamma: float = 0.99, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        key = jax.random.PRNGKey(seed)
+        self.params = {"q": _mlp_init(key, (obs_size, *hidden,
+                                            num_actions))}
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self._opt = optax.adam(lr)
+        self._opt_state = self._opt.init(self.params)
+
+        def loss_fn(params, target_params, batch):
+            q = _mlp_apply(params["q"], batch["obs"])
+            q_sa = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1)[:, 0]
+            # Double DQN: the ONLINE net picks the argmax action, the
+            # TARGET net evaluates it.
+            q_next_online = _mlp_apply(params["q"], batch["next_obs"])
+            best = jnp.argmax(q_next_online, axis=-1)
+            q_next_target = _mlp_apply(target_params["q"],
+                                       batch["next_obs"])
+            q_next = jnp.take_along_axis(q_next_target, best[:, None],
+                                         axis=1)[:, 0]
+            target = batch["rewards"] + gamma * (1.0 - batch["dones"]) \
+                * q_next
+            td = q_sa - jax.lax.stop_gradient(target)
+            # Huber: quadratic near 0, linear past 1 (stable with the
+            # occasional large TD error).
+            abs_td = jnp.abs(td)
+            huber = jnp.where(abs_td <= 1.0, 0.5 * td ** 2,
+                              abs_td - 0.5)
+            weights = batch.get("weights", jnp.ones_like(huber))
+            loss = jnp.mean(weights * huber)
+            return loss, {"td_abs": abs_td, "q_mean": jnp.mean(q_sa)}
+
+        # NO donation: target_params aliases params right after a sync
+        # (both point at the same buffers) and XLA rejects donating a
+        # buffer that another argument still uses.
+        @jax.jit
+        def update(params, opt_state, target_params, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["loss"] = loss
+            return params, opt_state, aux
+
+        self._update = update
+
+    def get_weights(self) -> Any:
+        import jax
+        return jax.tree.map(np.asarray, self.params)
+
+    def sync_target(self) -> None:
+        import jax
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+
+    def update(self, batch: Dict[str, np.ndarray]
+               ) -> Tuple[Dict[str, float], np.ndarray]:
+        """One update; returns (metrics, per-sample |TD| for priority
+        writes)."""
+        import jax.numpy as jnp
+
+        dev = {k: jnp.asarray(v) for k, v in batch.items()
+               if k != "indices"}
+        self.params, self._opt_state, aux = self._update(
+            self.params, self._opt_state, self.target_params, dev)
+        td_abs = np.asarray(aux.pop("td_abs"))
+        return {k: float(v) for k, v in aux.items()}, td_abs

@@ -40,8 +40,17 @@ class ASHAScheduler:
         while t < max_t:
             self.rungs.append(t)
             t *= reduction_factor
-        # rung milestone -> recorded metric values
-        self._recorded: Dict[int, List[float]] = {r: [] for r in self.rungs}
+        # rung milestone -> {trial_id: recorded metric}. Trial-keyed so a
+        # re-run (Tuner.restore) REPLACES its old entry instead of
+        # double-counting it against peers.
+        self._recorded: Dict[int, Dict[str, float]] = {
+            r: {} for r in self.rungs}
+
+    def on_trial_restore(self, trial_id: str) -> None:
+        """A restored trial restarts from iteration 0: drop its phase-1
+        rung entries so its re-reports don't double-count."""
+        for vals in self._recorded.values():
+            vals.pop(trial_id, None)
 
     def on_result(self, trial_id: str, iteration: int,
                   metric_value: float) -> str:
@@ -50,10 +59,10 @@ class ASHAScheduler:
         for rung in reversed(self.rungs):
             if iteration == rung:
                 vals = self._recorded[rung]
-                vals.append(metric_value)
+                vals[trial_id] = metric_value
                 if len(vals) < self.rf:
                     return CONTINUE  # not enough peers yet: optimistic
-                ranked = sorted(vals)
+                ranked = sorted(vals.values())
                 if self.mode == "max":
                     ranked = ranked[::-1]
                 cutoff = ranked[max(0, len(vals) // self.rf - 1)]
@@ -101,6 +110,12 @@ class PBTScheduler:
         """The tuner registers each trial's (live) config."""
         self._configs[trial_id] = dict(config)
         self._last_perturb.setdefault(trial_id, 0)
+
+    def on_trial_restore(self, trial_id: str) -> None:
+        """A restored trial restarts from iteration 0: clear its stale
+        metric and perturb clock (track() re-registers the config)."""
+        self._latest.pop(trial_id, None)
+        self._last_perturb[trial_id] = 0
 
     def _quantiles(self):
         ranked = sorted(self._latest.items(), key=lambda kv: kv[1],

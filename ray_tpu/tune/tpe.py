@@ -94,8 +94,11 @@ class TPESearcher(Searcher):
         lo, hi, fwd, inv = _numeric_transform(dom)
         g_vals = [fwd(o[0][key]) for o in good]
         b_vals = [fwd(o[0][key]) for o in bad]
-        # Parzen bandwidth: range-scaled, shrinking with observations.
-        bw = max((hi - lo) / max(2.0, math.sqrt(len(g_vals) + 1)), 1e-12)
+        # Parzen bandwidth: range-scaled, shrinking with the TOTAL
+        # observation count (a good-count-only denominator leaves the
+        # mixture near-uniform and proposals barely better than random).
+        n_total = len(g_vals) + len(b_vals)
+        bw = max((hi - lo) / max(4.0, float(n_total)), 1e-12)
         best_x, best_score = None, -math.inf
         for _ in range(self.n_candidates):
             center = self._rng.choice(g_vals)

@@ -199,6 +199,11 @@ class Tuner:
                     # Re-register so the searcher attributes the re-run's
                     # completion (its pending entry died with phase 1).
                     cfg.search_alg.on_trial_restore(t.trial_id, t.config)
+                if hasattr(scheduler, "on_trial_restore"):
+                    # Restored scheduler state (pickled with the config)
+                    # must drop the trial's phase-1 records: it restarts
+                    # from iteration 0.
+                    scheduler.on_trial_restore(t.trial_id)
                 return t
             # Searcher runs are capped at num_samples trials; the
             # default generator's sequence bounds itself (num_samples
