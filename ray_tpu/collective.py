@@ -188,18 +188,44 @@ def get_collective_group_size(group_name: str = "default") -> int:
     return _group(group_name).world
 
 
+_HUB_WARN_BYTES = 32 * 1024 * 1024
+_hub_warned = False
+
+
+def _guard_hub_size(nbytes: int, world: int, what: str) -> None:
+    """The CPU-fallback collectives funnel every rank's payload through
+    ONE coordinator actor — O(world x bytes) through a single process.
+    Fine for control-plane data; silently catastrophic for gradients.
+    Warn once and point at the in-jit path (SURVEY §5.8 plane 2)."""
+    global _hub_warned
+    if _hub_warned or nbytes * max(1, world - 1) < _HUB_WARN_BYTES:
+        return
+    _hub_warned = True
+    from ray_tpu.utils import get_logger
+    get_logger("collective").warning(
+        "%s is moving ~%.0f MB through the coordinator-actor hub "
+        "(O(world) through one process). For tensors this size use the "
+        "in-jit GSPMD collectives (jax.lax.psum over a mesh axis) or "
+        "DeviceRef transfers — the hub path is built for control-plane "
+        "payloads.", what, nbytes * max(1, world - 1) / 1e6)
+
+
 def allreduce(tensor, group_name: str = "default", op: str = "sum"):
     """Reduce across the group; returns the reduced tensor (same type in
     -> out for jax arrays; device transfer is the host hop of the
     fallback plane)."""
     g = _group(group_name)
-    out = _call(g, "allreduce", group_name, _to_host(tensor), reduce_op=op)
+    host = _to_host(tensor)
+    _guard_hub_size(host.nbytes, g.world, "allreduce")
+    out = _call(g, "allreduce", group_name, host, reduce_op=op)
     return _like(out, tensor)
 
 
 def allgather(tensor, group_name: str = "default") -> List[Any]:
     g = _group(group_name)
-    outs = _call(g, "allgather", group_name, _to_host(tensor))
+    host = _to_host(tensor)
+    _guard_hub_size(host.nbytes, g.world, "allgather")
+    outs = _call(g, "allgather", group_name, host)
     return [_like(o, tensor) for o in outs]
 
 
@@ -216,7 +242,9 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
     """Reduce then return this rank's equal slice along axis 0."""
     g = _group(group_name)
-    out = np.asarray(_call(g, "allreduce", group_name, _to_host(tensor),
+    host = _to_host(tensor)
+    _guard_hub_size(host.nbytes, g.world, "reducescatter")
+    out = np.asarray(_call(g, "allreduce", group_name, host,
                            reduce_op=op))
     if out.shape[0] % g.world != 0:
         raise ValueError(

@@ -758,6 +758,9 @@ class CoreWorker:
         if world == 1:
             return device_objects.device_put_ref(mine)
         if rank == 0:
+            from ray_tpu.collective import _guard_hub_size
+            _guard_hub_size(getattr(mine, "nbytes", 0), world,
+                            "DAG allreduce")
             acc = mine
             parts = [device_objects.device_get(inputs[j], timeout=timeout)
                      for j in range(world) if j != 0]

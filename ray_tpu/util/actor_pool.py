@@ -15,30 +15,30 @@ import ray_tpu
 class ActorPool:
     def __init__(self, actors: List[Any]):
         self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List[tuple] = []
+        self._inflight_by_ref = {}
+        self._ref_by_seq = {}
+        self._submit_seq = 0
+        self._consume_seq = 0
+        self._backlog: List[tuple] = []
 
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
         """fn(actor, value) -> ObjectRef; queues when all actors busy."""
         if self._idle:
             actor = self._idle.pop()
             ref = fn(actor, value)
-            self._future_to_actor[ref] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = ref
-            self._next_task_index += 1
+            self._inflight_by_ref[ref] = (self._submit_seq, actor)
+            self._ref_by_seq[self._submit_seq] = ref
+            self._submit_seq += 1
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
 
     def _return_actor(self, actor) -> None:
         self._idle.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.pop(0))
+        if self._backlog:
+            self.submit(*self._backlog.pop(0))
 
     def has_next(self) -> bool:
-        return bool(self._index_to_future)
+        return bool(self._ref_by_seq)
 
     def get_next(self, timeout: Optional[float] = None) -> Any:
         """Next result in SUBMISSION order. On timeout the task stays
@@ -46,14 +46,14 @@ class ActorPool:
         ready would lose the result and double-book the actor)."""
         if not self.has_next():
             raise StopIteration("no pending results")
-        ref = self._index_to_future[self._next_return_index]
+        ref = self._ref_by_seq[self._consume_seq]
         if timeout is not None:
             ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
             if not ready:
                 raise TimeoutError("next result not ready within timeout")
-        self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
-        _, actor = self._future_to_actor.pop(ref)
+        self._ref_by_seq.pop(self._consume_seq)
+        self._consume_seq += 1
+        _, actor = self._inflight_by_ref.pop(ref)
         try:
             return ray_tpu.get(ref)
         finally:
@@ -63,13 +63,13 @@ class ActorPool:
         """Next COMPLETED result, any order."""
         if not self.has_next():
             raise StopIteration("no pending results")
-        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+        ready, _ = ray_tpu.wait(list(self._inflight_by_ref),
                                 num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("no result within timeout")
         ref = ready[0]
-        index, actor = self._future_to_actor.pop(ref)
-        self._index_to_future.pop(index)
+        index, actor = self._inflight_by_ref.pop(ref)
+        self._ref_by_seq.pop(index)
         try:
             return ray_tpu.get(ref)
         finally:

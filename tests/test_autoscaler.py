@@ -74,3 +74,48 @@ def test_scale_up_then_down(cluster):
         assert len(alive) == 1, f"never scaled back down: {len(alive)}"
     finally:
         scaler.stop()
+
+
+def test_tpu_pod_provider_command_templates(tmp_path):
+    """TPUPodProvider drives slice create/delete through its command
+    templates (the cloud seam; reference: gcp node provider) — stub
+    commands record the exact invocations."""
+    import json
+    import os
+
+    from ray_tpu.autoscaler import TPUPodProvider
+
+    log = str(tmp_path / "calls.log")
+    rec = ["python", "-c",
+           "import sys, json; open(sys.argv[1], 'a').write("
+           "json.dumps(sys.argv[2:]) + '\\n')", log]
+    provider = TPUPodProvider(
+        zone="us-central2-b", accelerator_type="v5litepod-8",
+        controller_addr=("10.0.0.2", 7001), name_prefix="t",
+        create_cmd=rec + ["create", "{name}", "{zone}",
+                          "{accelerator_type}",
+                          "{controller}", "{agent_port}"],
+        delete_cmd=rec + ["delete", "{name}", "{zone}"])
+
+    h1 = provider.create_node({"TPU": 8.0, "CPU": 64.0})
+    h2 = provider.create_node({"TPU": 8.0, "CPU": 64.0})
+    assert provider.node_port(h1) == TPUPodProvider.AGENT_PORT
+    assert h1["name"] == "t-1" and h2["name"] == "t-2"
+    provider.terminate_node(h1)
+
+    deadline = time.monotonic() + 15  # launches are async
+    calls = []
+    while time.monotonic() < deadline and len(calls) < 3:
+        calls = [json.loads(line) for line in open(log)] \
+            if os.path.exists(log) else []
+        time.sleep(0.1)
+    assert calls[0] == ["create", "t-1", "us-central2-b", "v5litepod-8",
+                       "10.0.0.2:7001", str(TPUPodProvider.AGENT_PORT)]
+    assert calls[2] == ["delete", "t-1", "us-central2-b"]
+
+    # A failing create surfaces loudly (never a silent half-launch).
+    bad = TPUPodProvider(
+        zone="z", accelerator_type="a", controller_addr=("h", 1),
+        create_cmd=["false"], delete_cmd=["true"])
+    with pytest.raises(RuntimeError):
+        bad.create_node({})

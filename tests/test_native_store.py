@@ -1,0 +1,20 @@
+"""Native C++ object-store unit suite, driven from pytest.
+
+The gtest analogue the reference runs under Bazel (reference:
+src/ray/object_manager/plasma/ unit tests; SURVEY §4.1): `make test`
+builds csrc/object_store_test.cc against the exact translation unit the
+agent loads and exercises lifecycle, eviction-vs-pin-vs-refcount,
+ingest adoption, and concurrent index mutation at the C++ layer.
+"""
+
+import os
+import subprocess
+
+CSRC = os.path.join(os.path.dirname(__file__), "..", "csrc")
+
+
+def test_native_object_store_unit_suite():
+    out = subprocess.run(["make", "-s", "test"], cwd=os.path.abspath(CSRC),
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ALL OK" in out.stdout, out.stdout
