@@ -140,19 +140,43 @@ class TPUPodProvider(NodeProvider):
         """Start the cloud CLI WITHOUT blocking the reconcile thread
         (slice create/delete takes minutes; the reference's instance
         manager is similarly asynchronous). An immediately-failing
-        command (bad binary/flags) still raises here."""
+        command (bad binary/flags) still raises here; a background
+        reaper wait()s the child (no zombies) and drops the log on
+        success (failures keep theirs for debugging, with a warning)."""
         import tempfile
+        import threading
         log = tempfile.NamedTemporaryFile(
             mode="w+", prefix=f"raytpu-{what}-", suffix=".log",
             delete=False)
-        proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT)
-        time.sleep(0.2)
-        rc = proc.poll()
-        if rc is not None and rc != 0:
-            log.seek(0)
-            raise RuntimeError(
-                f"TPU slice {what} failed fast ({' '.join(cmd[:6])}...): "
-                f"{log.read()[-500:]}")
+        try:
+            proc = subprocess.Popen(cmd, stdout=log,
+                                    stderr=subprocess.STDOUT)
+            time.sleep(0.2)
+            rc = proc.poll()
+            if rc is not None and rc != 0:
+                log.seek(0)
+                tail = log.read()[-500:]
+                proc.wait()
+                raise RuntimeError(
+                    f"TPU slice {what} failed fast "
+                    f"({' '.join(cmd[:6])}...): {tail}")
+        finally:
+            log.close()
+
+        def reap():
+            rc = proc.wait()
+            if rc == 0:
+                try:
+                    import os
+                    os.unlink(log.name)
+                except OSError:
+                    pass
+            else:
+                logger.warning("TPU slice %s exited rc=%d (log: %s)",
+                               what, rc, log.name)
+
+        threading.Thread(target=reap, daemon=True,
+                         name=f"tpu-{what}-reaper").start()
         return proc
 
     def create_node(self, resources: Dict[str, float]):

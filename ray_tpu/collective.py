@@ -235,6 +235,8 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     # (the rendezvous key alone synchronizes them) — no point shipping
     # world-1 full tensors that get discarded.
     payload = _to_host(tensor) if g.rank == src_rank else None
+    if payload is not None:
+        _guard_hub_size(payload.nbytes, g.world, "broadcast")
     out = _call(g, "broadcast", group_name, payload, src_rank=src_rank)
     return _like(out, tensor)
 

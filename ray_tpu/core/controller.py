@@ -103,6 +103,22 @@ class Controller:
         #   actor_events — {"actor_id", "state", "addr", "death_reason"}
         #   log_events   — driver-facing error/log lines
         self.pubsub = PubsubHub()
+        # Structured event export (reference: ray_event_recorder.cc +
+        # aggregator pipeline): every pubsub-published lifecycle event
+        # and task transition also lands in the JSONL sink when
+        # event_export_path is set.
+        from ray_tpu.utils.events import exporter_from_config
+        self._event_exporter = exporter_from_config()
+        if self._event_exporter is not None:
+            hub_publish = self.pubsub.publish
+
+            def publish_and_export(channel, event,
+                                   _pub=hub_publish):
+                if channel != "log_events":  # log lines are not events
+                    self._event_exporter.emit(channel, event)
+                return _pub(channel, event)
+
+            self.pubsub.publish = publish_and_export
         # Observability sinks (reference: gcs_task_manager.cc task events
         # + the metrics agent pipeline).
         from collections import deque
@@ -252,6 +268,10 @@ class Controller:
 
     async def report_task_events(self, events: list) -> None:
         self.task_events.extend(events)
+        if self._event_exporter is not None:
+            for ev in events:
+                self._event_exporter.emit("task_events", ev)
+            self._event_exporter.flush()
 
     async def list_task_events(self, limit: int = 1000) -> list:
         return list(self.task_events)[-limit:]
@@ -385,6 +405,8 @@ class Controller:
             if time.monotonic() - last_reconcile > 10.0:
                 last_reconcile = time.monotonic()
                 await self._reconcile_bundles()
+            if self._event_exporter is not None:
+                self._event_exporter.flush()
 
     async def _reconcile_bundles(self) -> None:
         """Release ORPHANED bundle reservations on agents: a controller

@@ -470,6 +470,42 @@ class Dataset:
         from ray_tpu.data.split import create_streaming_split
         return create_streaming_split(self, n, equal=equal)
 
+    # ------------------------------------------------------------------
+    # writers (reference: dataset.py write_parquet/write_csv/write_json
+    # -> one output file per block, written by parallel tasks)
+    # ------------------------------------------------------------------
+    def _write(self, path: str, file_format: str,
+               filename_prefix: str) -> List[str]:
+        import os
+
+        from ray_tpu.data.datasource import write_block
+
+        os.makedirs(path, exist_ok=True)
+
+        @ray_tpu.remote
+        def _write_one(block, out_path):
+            return write_block(block, out_path, file_format)
+
+        refs = []
+        for i, block_ref in enumerate(self.iter_block_refs()):
+            out = os.path.join(
+                path, f"{filename_prefix}-{i:05d}.{file_format}")
+            refs.append(_write_one.remote(block_ref, out))
+        return ray_tpu.get(refs)
+
+    def write_parquet(self, path: str, *,
+                      filename_prefix: str = "part") -> List[str]:
+        return self._write(path, "parquet", filename_prefix)
+
+    def write_csv(self, path: str, *,
+                  filename_prefix: str = "part") -> List[str]:
+        return self._write(path, "csv", filename_prefix)
+
+    def write_json(self, path: str, *,
+                   filename_prefix: str = "part") -> List[str]:
+        """JSON-lines, one file per block."""
+        return self._write(path, "json", filename_prefix)
+
     def stats(self) -> Dict[str, Any]:
         """Executed-operator metrics of the LAST full execution are not
         retained (pull-driven executions are per-iterator); use
@@ -599,3 +635,24 @@ def read_csv(paths) -> Dataset:
 
 def read_json(paths) -> Dataset:
     return Dataset(_ds.json_read_tasks(paths), name="read_json")
+
+
+def read_text(paths, *, encoding: str = "utf-8") -> Dataset:
+    """One row per line, column "text" (reference: ray.data.read_text)."""
+    return Dataset(_ds.text_read_tasks(paths, encoding=encoding),
+                   name="read_text")
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    """One row per file, column "bytes" (reference:
+    ray.data.read_binary_files)."""
+    return Dataset(_ds.binary_read_tasks(paths,
+                                         include_paths=include_paths),
+                   name="read_binary_files")
+
+
+def read_images(paths, *, size=None, mode: Optional[str] = None) -> Dataset:
+    """One row per image, column "image" as [H, W, C] arrays (reference:
+    ray.data.read_images; size=(w, h) resizes, mode converts e.g. "RGB")."""
+    return Dataset(_ds.image_read_tasks(paths, size=size, mode=mode),
+                   name="read_images")

@@ -128,3 +128,97 @@ def json_read_tasks(paths):
 
         tasks.append(read)
     return tasks
+
+
+def text_read_tasks(paths, *, encoding: str = "utf-8"):
+    """One task per file; each block is {"text": lines} (reference:
+    _internal/datasource/text_datasource.py)."""
+    files = _expand_paths(paths)
+    tasks = []
+    for path in files:
+        def read(path=path):
+            with open(path, encoding=encoding) as f:
+                lines = f.read().splitlines()
+            yield {"text": np.asarray(lines, dtype=object)}
+
+        tasks.append(read)
+    return tasks
+
+
+def binary_read_tasks(paths, *, include_paths: bool = False):
+    """One task per file; blocks are {"bytes": [payload]} (+"path")
+    (reference: _internal/datasource/binary_datasource.py)."""
+    files = _expand_paths(paths)
+    tasks = []
+    for path in files:
+        def read(path=path):
+            with open(path, "rb") as f:
+                payload = f.read()
+            block = {"bytes": np.asarray([payload], dtype=object)}
+            if include_paths:
+                block["path"] = np.asarray([path], dtype=object)
+            yield block
+
+        tasks.append(read)
+    return tasks
+
+
+def image_read_tasks(paths, *, size=None, mode: Optional[str] = None):
+    """One task per image file; blocks are {"image": [H, W, C] uint8}
+    (reference: _internal/datasource/image_datasource.py — PIL decode,
+    optional resize/convert; decoding runs IN the read task, so it
+    parallelizes across the executor's task budget)."""
+    files = _expand_paths(paths)
+    tasks = []
+    for path in files:
+        def read(path=path):
+            from PIL import Image
+            img = Image.open(path)
+            if mode is not None:
+                img = img.convert(mode)
+            if size is not None:
+                img = img.resize(tuple(size))
+            yield {"image": np.asarray(img)[None]}
+
+        tasks.append(read)
+    return tasks
+
+
+# ---------------------------------------------------------------------------
+# write tasks (reference: Dataset.write_parquet/_csv/_json ->
+# _internal/datasource/*_datasink.py — one output file per block)
+# ---------------------------------------------------------------------------
+
+def write_block(block, path: str, file_format: str) -> str:
+    """Write ONE block as one file (runs inside a task)."""
+    import pyarrow as pa
+
+    from ray_tpu.data.block import BlockAccessor
+
+    acc = BlockAccessor(block)
+    table = acc.to_arrow() if not isinstance(block, pa.Table) else block
+    if file_format == "parquet":
+        import pyarrow.parquet as pq
+        pq.write_table(table, path)
+    elif file_format == "csv":
+        import pyarrow.csv as pacsv
+        pacsv.write_csv(table, path)
+    elif file_format == "json":
+        import json as _json
+        cols = acc.to_numpy_batch()
+        names = list(cols)
+        with open(path, "w") as f:
+            for i in range(acc.num_rows()):
+                row = {k: _to_jsonable(cols[k][i]) for k in names}
+                f.write(_json.dumps(row) + "\n")
+    else:
+        raise ValueError(f"unknown write format {file_format!r}")
+    return path
+
+
+def _to_jsonable(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v

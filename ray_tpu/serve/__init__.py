@@ -21,13 +21,15 @@ via @serve.batch so the MXU sees full batches.
 """
 
 from ray_tpu.serve.api import (Application, Deployment, batch, delete,
-                               deployment, get_deployment_handle, get_proxy,
-                               run, shutdown, start)
+                               deployment, get_deployment_handle,
+                               get_grpc_proxy, get_proxy, run, shutdown,
+                               start)
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application", "Deployment", "DeploymentHandle", "DeploymentResponse",
-    "batch", "delete", "deployment", "get_deployment_handle", "get_proxy",
+    "batch", "delete", "deployment", "get_deployment_handle",
+    "get_grpc_proxy", "get_proxy",
     "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
 ]

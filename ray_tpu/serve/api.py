@@ -71,12 +71,16 @@ def deployment(cls: Optional[type] = None, *, name: Optional[str] = None,
 
 _controller_handle = None
 _proxy = None
+_grpc_proxy = None
 
 
 def start(*, http: bool = False, http_port: int = 0,
-          http_host: str = "127.0.0.1"):
-    """Ensure the Serve controller (and optionally the HTTP proxy) is up."""
-    global _controller_handle, _proxy
+          http_host: str = "127.0.0.1", grpc: bool = False,
+          grpc_port: int = 0):
+    """Ensure the Serve controller (and optionally the HTTP and/or gRPC
+    ingress proxies) is up (reference: serve.start + proxies per node,
+    serve/_private/proxy.py HTTPProxy:706 / gRPCProxy:530)."""
+    global _controller_handle, _proxy, _grpc_proxy
     if _controller_handle is None:
         try:
             _controller_handle = ray_tpu.get_actor(
@@ -91,12 +95,20 @@ def start(*, http: bool = False, http_port: int = 0,
     if http and _proxy is None:
         from ray_tpu.serve.proxy import HttpProxy
         _proxy = HttpProxy(_controller_handle, http_host, http_port)
+    if grpc and _grpc_proxy is None:
+        from ray_tpu.serve.grpc_proxy import GrpcProxy
+        _grpc_proxy = GrpcProxy(_controller_handle, http_host, grpc_port)
     return _controller_handle
 
 
 def get_proxy():
     """The in-process HTTP proxy started by serve.start(http=True)."""
     return _proxy
+
+
+def get_grpc_proxy():
+    """The in-process gRPC proxy started by serve.start(grpc=True)."""
+    return _grpc_proxy
 
 
 def run(app: "Application | Deployment", *, name: Optional[str] = None,
@@ -143,10 +155,16 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
-    global _controller_handle, _proxy
+    global _controller_handle, _proxy, _grpc_proxy
     if _proxy is not None:
         _proxy.stop()
         _proxy = None
+    if _grpc_proxy is not None:
+        try:
+            _grpc_proxy.stop()
+        except Exception:
+            pass
+        _grpc_proxy = None
     if _controller_handle is not None:
         try:
             ray_tpu.get(_controller_handle.shutdown_serve.remote(),

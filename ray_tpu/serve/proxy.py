@@ -25,12 +25,11 @@ logger = get_logger("serve.proxy")
 class HttpProxy:
     def __init__(self, controller_handle, host: str = "127.0.0.1",
                  port: int = 0):
+        from ray_tpu.serve.routing import RouteTable
         self._controller = controller_handle
         self._host = host
         self.port = port
-        self._handles: Dict[str, DeploymentHandle] = {}
-        self._routes: Dict[str, str] = {}  # route_prefix -> deployment
-        self._routes_version = -1
+        self._table = RouteTable(controller_handle)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._serve_thread,
@@ -56,47 +55,19 @@ class HttpProxy:
             self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=5)
 
-    # -- routing ---------------------------------------------------------
-    _NEG_CACHE_TTL_S = 2.0  # unknown-path probes must not hammer refresh
-
-    def _refresh_routes(self) -> None:
-        table = ray_tpu.get(self._controller.list_deployments.remote(),
-                            timeout=10)
-        # Build fully, assign once: this runs off-loop while the event
-        # loop reads self._routes (in-place clearing would 404 live
-        # routes mid-refresh).
-        routes = {}
-        for name, info in table.items():
-            prefix = info["config"].get("route_prefix") or f"/{name}"
-            routes[prefix] = name
-        self._routes = routes
-
-    def _match(self, path: str) -> Optional[str]:
-        # Longest-prefix match (reference: proxy route resolution).
-        return max((p for p in self._routes
-                    if path == p or path.startswith(p + "/")),
-                   key=len, default=None)
-
+    # -- routing (table shared with the gRPC ingress: routing.py) --------
     async def _handle_for(self, path: str) -> Optional[DeploymentHandle]:
-        match = self._match(path)
-        if match is None:
+        name = self._table.match(path)
+        if name is None and self._table.should_refresh():
             # Refresh OFF the event loop (a blocking controller RPC here
             # would stall every in-flight connection), rate-limited so
             # 404 scans can't DoS the ingress.
-            import time as _time
-            now = _time.monotonic()
-            if now - getattr(self, "_last_refresh", 0.0) \
-                    > self._NEG_CACHE_TTL_S:
-                self._last_refresh = now
-                await asyncio.get_running_loop().run_in_executor(
-                    None, self._refresh_routes)
-                match = self._match(path)
-        if match is None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._table.refresh)
+            name = self._table.match(path)
+        if name is None:
             return None
-        name = self._routes[match]
-        if name not in self._handles:
-            self._handles[name] = DeploymentHandle(name, self._controller)
-        return self._handles[name]
+        return self._table.handle_for(name)
 
     # -- request handling -------------------------------------------------
     async def _on_client(self, reader: asyncio.StreamReader,
@@ -156,8 +127,9 @@ class HttpProxy:
             return
         if path == "/-/routes":
             await asyncio.get_running_loop().run_in_executor(
-                None, self._refresh_routes)
-            self._respond(writer, 200, json.dumps(self._routes).encode())
+                None, self._table.refresh)
+            self._respond(writer, 200,
+                          json.dumps(self._table.routes).encode())
             await writer.drain()
             return
         handle = await self._handle_for(path)

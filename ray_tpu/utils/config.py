@@ -105,6 +105,7 @@ _flag("collective_cpu_fallback", bool, True, "Allow CPU fallback collectives whe
 _flag("cross_slice_device_dma", bool, False, "Let the PJRT transfer plane pull device objects ACROSS slice boundaries. Off (default): cross-slice device_get host-relays through the object plane (device->host->DCN RPC->device), the safe path when slices share no ICI/DMA domain.")
 
 # --- logging / observability ---
+_flag("event_export_path", str, "", "JSONL sink for structured lifecycle events (node/actor/job/serve pubsub + task transitions); empty disables (reference: export-API aggregator pipeline).")
 _flag("log_to_driver", bool, True, "Stream worker stdout/stderr lines to the driver via the controller log_events channel. NOTE: the channel is cluster-global (no per-job scoping yet); multiple concurrent drivers see each other's worker output.")
 _flag("event_stats_enabled", bool, True, "Record per-handler event-loop stats.")
 _flag("task_events_batch_size", int, 1000, "Task events per batch sent to controller.")

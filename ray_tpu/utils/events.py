@@ -1,0 +1,74 @@
+"""Structured event export — lifecycle events to a JSONL sink.
+
+Analogue of the reference's export-API pipeline (reference:
+src/ray/observability/ray_event_recorder.cc structured lifecycle events +
+dashboard/modules/aggregator/aggregator_agent.py shipping export_*.proto
+events to external sinks). Slimmed to the durable core: every control-
+plane event (node/actor/job/serve lifecycle via the pubsub hub, plus
+task state transitions) appends as one JSON line to
+``event_export_path`` — the integration seam log shippers tail.
+
+Enable with RAY_TPU_EVENT_EXPORT_PATH=/path/events.jsonl (or the
+event_export_path config flag).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+
+class EventExporter:
+    """Buffered JSONL appender (thread-safe; best-effort — an export
+    failure must never take down the control plane)."""
+
+    _FLUSH_EVERY = 64
+
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.Lock()
+        self._buf: list = []
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def emit(self, source: str, event: Any) -> None:
+        rec = {"ts": time.time(), "source": source,
+               "event": _jsonable(event)}
+        with self._lock:
+            self._buf.append(json.dumps(rec))
+            if len(self._buf) >= self._FLUSH_EVERY:
+                self._flush_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._buf:
+            return
+        lines, self._buf = self._buf, []
+        try:
+            with open(self._path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+        except OSError:
+            pass  # best-effort: never fail the control plane
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, dict):
+        return {str(_jsonable(k)): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, bytes):
+        return v.hex()
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return repr(v)
+
+
+def exporter_from_config() -> Optional[EventExporter]:
+    from ray_tpu.utils.config import GlobalConfig
+    path = GlobalConfig.event_export_path
+    return EventExporter(path) if path else None

@@ -62,6 +62,10 @@ def test_streaming_overlap(cluster):
         time.sleep(0.4)
         return batch
 
+    # Warm the worker pool first: on a loaded 1-core host, 8 cold worker
+    # spawns (~0.5s each, serialized) would swamp the overlap signal.
+    rdata.range(8, num_blocks=8).map_batches(lambda b: b).take_all()
+
     ds = rdata.range(8 * 64, num_blocks=8).map_batches(slow_stage)
     t0 = time.monotonic()
     first = next(iter(ds.iter_batches(batch_size=None)))
@@ -245,3 +249,50 @@ def test_union_with_downstream_transform_and_empty_sort(cluster):
         lambda b: {"id": b["id"] * 2}).take_all())
     assert doubled == sorted([2 * i for i in range(6)] * 2)
     assert rdata.from_items([]).sort("id").take_all() == []
+
+
+def test_read_text_and_binary(cluster, tmp_path):
+    p1 = tmp_path / "a.txt"
+    p1.write_text("alpha\nbeta\ngamma\n")
+    p2 = tmp_path / "b.bin"
+    p2.write_bytes(b"\x00\x01payload")
+    ds = rdata.read_text(str(p1))
+    assert [r["text"] for r in ds.take_all()] == ["alpha", "beta", "gamma"]
+    bs = rdata.read_binary_files(str(p2), include_paths=True)
+    rows = bs.take_all()
+    assert rows[0]["bytes"] == b"\x00\x01payload"
+    assert rows[0]["path"].endswith("b.bin")
+
+
+def test_read_images(cluster, tmp_path):
+    from PIL import Image
+    for i in range(3):
+        Image.new("RGB", (8, 6), color=(i * 10, 0, 0)).save(
+            tmp_path / f"img{i}.png")
+    ds = rdata.read_images(str(tmp_path), size=(4, 4), mode="L")
+    imgs = [r["image"] for r in ds.take_all()]
+    assert len(imgs) == 3
+    assert all(im.shape == (4, 4) for im in imgs)
+
+
+def test_writers_roundtrip(cluster, tmp_path):
+    """write_parquet/csv/json produce one file per block; reading them
+    back yields the same rows (reference: Dataset.write_* datasinks)."""
+    ds = rdata.range(40, num_blocks=4).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+
+    pq_files = ds.write_parquet(str(tmp_path / "pq"))
+    assert len(pq_files) == 4
+    back = rdata.read_parquet(str(tmp_path / "pq"))
+    assert sorted(r["id"] for r in back.take_all()) == list(range(40))
+
+    csv_files = ds.write_csv(str(tmp_path / "csv"))
+    assert len(csv_files) == 4
+    back = rdata.read_csv(str(tmp_path / "csv"))
+    assert sorted(r["sq"] for r in back.take_all()) == \
+        [i ** 2 for i in range(40)]
+
+    js_files = ds.write_json(str(tmp_path / "js"))
+    import json
+    rows = [json.loads(line) for f in js_files for line in open(f)]
+    assert sorted(r["id"] for r in rows) == list(range(40))

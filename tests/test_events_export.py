@@ -1,0 +1,61 @@
+"""Structured event export: lifecycle + task events land in the JSONL
+sink (reference: export-API aggregator pipeline; SURVEY §5.5 events).
+"""
+
+import json
+import os
+import time
+
+import ray_tpu
+from ray_tpu.utils.config import GlobalConfig
+
+
+def test_event_export_jsonl(tmp_path):
+    sink = str(tmp_path / "events.jsonl")
+    GlobalConfig.initialize({"event_export_path": sink})
+    from ray_tpu.core.cluster_utils import Cluster
+    c = Cluster(num_nodes=1, resources={"CPU": 4})
+    c.connect()
+    try:
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        a = A.options(name="exported").remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+        ray_tpu.kill(a)
+
+        @ray_tpu.remote
+        def f():
+            return 2
+
+        assert ray_tpu.get(f.remote(), timeout=60) == 2
+
+        deadline = time.monotonic() + 30
+        events = []
+        while time.monotonic() < deadline:
+            if os.path.exists(sink):
+                events = [json.loads(ln) for ln in open(sink)]
+                sources = {e["source"] for e in events}
+                if {"node_events", "actor_events",
+                        "task_events"} <= sources:
+                    break
+            time.sleep(0.3)
+        sources = {e["source"] for e in events}
+        assert {"node_events", "actor_events", "task_events"} <= sources, \
+            sources
+        # Events are structured: node add, actor ALIVE, task finished.
+        node_adds = [e for e in events if e["source"] == "node_events"
+                     and e["event"].get("type") == "added"]
+        assert node_adds and "node_id" in node_adds[0]["event"]
+        alive = [e for e in events if e["source"] == "actor_events"
+                 and e["event"].get("state") == "ALIVE"]
+        assert alive
+        finished = [e for e in events if e["source"] == "task_events"
+                    and e["event"].get("event") == "finished"]
+        assert finished
+    finally:
+        c.shutdown()
+        GlobalConfig._overrides.clear()
+        GlobalConfig._cache.clear()

@@ -306,3 +306,70 @@ def test_model_multiplexing_lru_eviction(cluster):
         timeout=60)
     assert loads == ["a", "b", "c", "a"], loads  # c cached, no reload
     serve.delete("mux1")
+
+
+def test_grpc_ingress_unary_and_streaming():
+    """gRPC ingress (reference: serve/_private/proxy.py:530 gRPCProxy):
+    unary Call, server-streaming Stream, route resolution by app name
+    and route prefix, NOT_FOUND/INTERNAL status mapping."""
+    import json
+
+    grpc = pytest.importorskip("grpc")
+
+    c = Cluster(num_nodes=1, resources={"CPU": 6})
+    c.connect()
+    try:
+        serve.start(grpc=True)
+
+        @serve.deployment
+        class Echo:
+            def __call__(self, body):
+                return {"echo": body}
+
+            def fail(self, body):
+                raise ValueError("boom")
+
+            def counted(self, n):
+                for i in range(int(n)):
+                    yield f"tok{i} "
+
+        serve.run(Echo.bind(), name="echo")
+        port = serve.get_grpc_proxy().port
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        call = ch.unary_unary("/raytpu.serve.ServeAPI/Call")
+        stream = ch.unary_stream("/raytpu.serve.ServeAPI/Stream")
+        routes = ch.unary_unary("/raytpu.serve.ServeAPI/Routes")
+
+        # Routes endpoint sees the deployment.
+        table = json.loads(routes(b""))
+        assert table.get("/echo") == "echo"
+
+        # Unary by app name and by route prefix.
+        out = json.loads(call(json.dumps(
+            {"app": "echo", "payload": {"x": 1}}).encode()))
+        assert out == {"result": {"echo": {"x": 1}}}
+        out = json.loads(call(json.dumps(
+            {"route": "/echo", "payload": "hi"}).encode()))
+        assert out == {"result": {"echo": "hi"}}
+
+        # Server streaming (generator method).
+        frames = list(stream(json.dumps(
+            {"app": "echo", "method": "counted", "payload": 4}).encode()))
+        assert b"".join(frames) == b"tok0 tok1 tok2 tok3 "
+
+        # Unroutable -> NOT_FOUND; application error -> INTERNAL.
+        try:
+            call(json.dumps({"app": "nope", "payload": 1}).encode())
+            assert False, "expected NOT_FOUND"
+        except grpc.RpcError as e:
+            assert e.code() == grpc.StatusCode.NOT_FOUND
+        try:
+            call(json.dumps({"app": "echo", "method": "fail",
+                             "payload": 1}).encode())
+            assert False, "expected INTERNAL"
+        except grpc.RpcError as e:
+            assert e.code() == grpc.StatusCode.INTERNAL
+        ch.close()
+    finally:
+        serve.shutdown()
+        c.shutdown()
