@@ -126,6 +126,7 @@ class WorkerProc:
         self.idle_since: float = 0.0
         self.spawned_at: float = time.monotonic()
         self.max_restarts: int = 0  # for dedicated actor workers
+        self.cgroup_scope = None    # WorkerCgroup for isolated workers
 
 
 class NodeAgent:
@@ -195,6 +196,7 @@ class NodeAgent:
         # pg_id -> bundle_index -> resources (prepared or committed)
         self.bundles: Dict[bytes, Dict[int, Dict[str, float]]] = {}
         self._bundle_prepared_at: Dict[tuple, float] = {}
+        self._worker_seq = 0  # isolated-worker cgroup scope naming
         self.bundle_available: Dict[Tuple[bytes, int], Dict[str, float]] = {}
         self._peer_clients: Dict[Address, RpcClient] = {}
         self._resource_cv = asyncio.Condition()
@@ -405,6 +407,9 @@ class NodeAgent:
         self.workers.pop(w.worker_id, None)
         if w in self.idle_workers:
             self.idle_workers.remove(w)
+        scope = getattr(w, "cgroup_scope", None)
+        if scope is not None:
+            scope.cleanup()
         if w.current_lease is not None:
             lease = self.leases.pop(w.current_lease, None)
             if lease:
@@ -514,7 +519,9 @@ class NodeAgent:
             return python
 
     def _spawn_worker(self, extra_env: Optional[Dict[str, str]] = None,
-                      python_exe: Optional[str] = None) -> WorkerProc:
+                      python_exe: Optional[str] = None,
+                      memory_bytes: Optional[int] = None,
+                      cpus: Optional[float] = None) -> WorkerProc:
         env = dict(os.environ)
         env["RAY_TPU_AGENT_ADDR"] = f"{self.host}:{self.port}"
         env["RAY_TPU_CONTROLLER_ADDR"] = \
@@ -539,15 +546,43 @@ class NodeAgent:
             # Piped stdout would otherwise block-buffer: prints inside
             # tasks must reach the driver promptly.
             env["PYTHONUNBUFFERED"] = "1"
-        proc = subprocess.Popen(
-            [python_exe or sys.executable, "-m",
-             "ray_tpu.core.worker_main"],
-            env=env, cwd=os.getcwd(),
-            stdout=subprocess.PIPE if capture else None,
-            stderr=subprocess.STDOUT if capture else None,
-            text=capture or None,
-            errors="replace" if capture else None)
+        # Resource isolation for DEDICATED workers (reference:
+        # src/ray/common/cgroup2/): cgroup v2 scope when writable, heap
+        # rlimit as the opt-in fallback; otherwise the node memory
+        # monitor's OOM policy is the only enforcement.
+        from ray_tpu.utils.cgroups import (create_worker_cgroup,
+                                           rlimit_preexec)
+        scope = None
+        preexec = None
+        if memory_bytes or cpus:
+            if GlobalConfig.cgroup_isolation:
+                scope = create_worker_cgroup(
+                    f"w-{os.getpid()}-{self._worker_seq}",
+                    memory_bytes=memory_bytes, cpus=cpus)
+                self._worker_seq += 1
+                if not scope.active:
+                    scope = None
+            if scope is None and memory_bytes \
+                    and GlobalConfig.worker_rlimit_memory:
+                preexec = rlimit_preexec(int(memory_bytes))
+        try:
+            proc = subprocess.Popen(
+                [python_exe or sys.executable, "-m",
+                 "ray_tpu.core.worker_main"],
+                env=env, cwd=os.getcwd(),
+                stdout=subprocess.PIPE if capture else None,
+                stderr=subprocess.STDOUT if capture else None,
+                text=capture or None,
+                errors="replace" if capture else None,
+                preexec_fn=preexec)
+        except BaseException:
+            if scope is not None:  # never leak the cgroup dir
+                scope.cleanup()
+            raise
+        if scope is not None:
+            scope.add_pid(proc.pid)
         w = WorkerProc(proc, b"")
+        w.cgroup_scope = scope
         self._pending_registration[proc.pid] = w
         if capture:
             self._start_log_pump(proc)
@@ -872,7 +907,11 @@ class NodeAgent:
             # try: a failed venv build must roll back the resources and
             # chips reserved above, like any other startup failure.
             python_exe = await self._ensure_pip_env(pip) if pip else None
-            w = self._spawn_worker(env_vars, python_exe)  # dedicated, never pooled
+            w = self._spawn_worker(  # dedicated, never pooled
+                env_vars, python_exe,
+                memory_bytes=int(resources["memory"])
+                if resources.get("memory") else None,
+                cpus=float(resources.get("CPU", 0)) or None)
             await asyncio.wait_for(w.ready.wait(),
                                    GlobalConfig.worker_register_timeout_s)
             w.dedicated_actor = actor_id

@@ -191,18 +191,19 @@ def image_read_tasks(paths, *, size=None, mode: Optional[str] = None):
 
 def write_block(block, path: str, file_format: str) -> str:
     """Write ONE block as one file (runs inside a task)."""
-    import pyarrow as pa
-
     from ray_tpu.data.block import BlockAccessor
 
     acc = BlockAccessor(block)
-    table = acc.to_arrow() if not isinstance(block, pa.Table) else block
-    if file_format == "parquet":
-        import pyarrow.parquet as pq
-        pq.write_table(table, path)
-    elif file_format == "csv":
-        import pyarrow.csv as pacsv
-        pacsv.write_csv(table, path)
+    if file_format in ("parquet", "csv"):
+        import pyarrow as pa
+        table = acc.to_arrow() if not isinstance(block, pa.Table) \
+            else block
+        if file_format == "parquet":
+            import pyarrow.parquet as pq
+            pq.write_table(table, path)
+        else:
+            import pyarrow.csv as pacsv
+            pacsv.write_csv(table, path)
     elif file_format == "json":
         import json as _json
         cols = acc.to_numpy_batch()
@@ -221,4 +222,7 @@ def _to_jsonable(v):
         return v.item()
     if isinstance(v, np.ndarray):
         return v.tolist()
+    if isinstance(v, (bytes, bytearray)):
+        import base64
+        return base64.b64encode(bytes(v)).decode()  # JSON-safe binary
     return v

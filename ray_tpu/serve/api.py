@@ -111,19 +111,76 @@ def get_grpc_proxy():
     return _grpc_proxy
 
 
+class _HandleMarker:
+    """Serialization-safe stand-in for a bound child deployment inside a
+    parent's init args; replicas resolve it to a DeploymentHandle at
+    construction (reference: serve model composition —
+    Deployment.bind(child.bind()) wires handles through init args)."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+
+
+def _map_nested(convert, v):
+    """Apply convert through lists/tuples/dicts (init args commonly
+    carry children inside containers)."""
+    out = convert(v)
+    if out is not v:
+        return out
+    if isinstance(v, list):
+        return [_map_nested(convert, x) for x in v]
+    if isinstance(v, tuple):
+        return tuple(_map_nested(convert, x) for x in v)
+    if isinstance(v, dict):
+        return {k: _map_nested(convert, x) for k, x in v.items()}
+    return v
+
+
+def _resolve_handle_markers(args: tuple, kwargs: dict):
+    """Replica-side: markers -> live DeploymentHandles."""
+    def convert(v):
+        if isinstance(v, _HandleMarker):
+            return get_deployment_handle(v.deployment_name)
+        return v
+
+    return tuple(_map_nested(convert, a) for a in args), \
+        {k: _map_nested(convert, v) for k, v in kwargs.items()}
+
+
+def _deploy_children(args: tuple, kwargs: dict):
+    """Driver-side: deploy every bound child Application found in the
+    parent's init args (recursing through containers) and substitute
+    markers."""
+    def convert(v):
+        if isinstance(v, Application):
+            child_handle = run(v)
+            return _HandleMarker(child_handle._deployment)
+        if isinstance(v, Deployment):
+            child_handle = run(v.bind())
+            return _HandleMarker(child_handle._deployment)
+        return v
+
+    return tuple(_map_nested(convert, a) for a in args), \
+        {k: _map_nested(convert, v) for k, v in kwargs.items()}
+
+
 def run(app: "Application | Deployment", *, name: Optional[str] = None,
         route_prefix: Optional[str] = None) -> DeploymentHandle:
     """Deploy (upsert) an application; blocks until replicas are live
-    (reference: serve.run, serve/api.py:685)."""
+    (reference: serve.run, serve/api.py:685). Bound child deployments in
+    the init args deploy first and arrive in the constructor as
+    DeploymentHandles (app composition)."""
     controller = start()
     if isinstance(app, Deployment):
         app = app.bind()
     dep = app.deployment
     dep_name = name or dep.name
+    init_args, init_kwargs = _deploy_children(app.init_args,
+                                              app.init_kwargs)
     config = dict(dep._config)
     config["cls_blob"] = cloudpickle.dumps(dep._cls)
     config["init_args_blob"] = cloudpickle.dumps(
-        (app.init_args, app.init_kwargs))
+        (init_args, init_kwargs))
     config["route_prefix"] = route_prefix or f"/{dep_name}"
     ray_tpu.get(controller.deploy.remote(dep_name,
                                          cloudpickle.dumps(config)),

@@ -25,6 +25,11 @@ class Replica:
                  deployment_name: str, max_ongoing: int = 100):
         cls = cloudpickle.loads(cls_blob)
         args, kwargs = cloudpickle.loads(init_args_blob)
+        # App composition (reference: serve/handle.py model composition):
+        # bound child deployments arrive as markers; resolve each to a
+        # live DeploymentHandle here, in the replica process.
+        from ray_tpu.serve.api import _resolve_handle_markers
+        args, kwargs = _resolve_handle_markers(args, kwargs)
         self._user = cls(*args, **kwargs)
         self._name = deployment_name
         self._max_ongoing = max_ongoing

@@ -89,6 +89,10 @@ _flag("actor_max_restarts_default", int, 0, "Default actor restarts.")
 _flag("lineage_pinning_enabled", bool, True, "Pin lineage for object reconstruction.")
 _flag("gcs_storage_path", str, "", "Controller state snapshot file; empty = in-memory only (the reference's Redis-backed GCS fault tolerance analogue).")
 
+# --- worker isolation (reference: src/ray/common/cgroup2/) ---
+_flag("cgroup_isolation", bool, True, "Put dedicated actor workers with memory/CPU requests into cgroup v2 scopes when the unified hierarchy is writable.")
+_flag("worker_rlimit_memory", bool, False, "Fallback when cgroups are unavailable: cap a dedicated worker's heap (RLIMIT_DATA) at its 'memory' resource request.")
+
 # --- memory monitor / OOM (reference: src/ray/common/memory_monitor.h + raylet/worker_killing_policy.cc) ---
 _flag("memory_monitor_refresh_ms", int, 500, "Node memory poll period; 0 disables OOM killing.")
 _flag("memory_usage_threshold", float, 0.95, "Kill a worker when node memory use exceeds this fraction.")

@@ -373,3 +373,45 @@ def test_grpc_ingress_unary_and_streaming():
     finally:
         serve.shutdown()
         c.shutdown()
+
+
+def test_app_composition_bound_children():
+    """Model composition (reference: serve/handle.py deployment graphs):
+    a parent bound with child Applications gets live DeploymentHandles
+    in its constructor; children deploy automatically with the parent."""
+    c = Cluster(num_nodes=1, resources={"CPU": 6})
+    c.connect()
+    try:
+        serve.start()
+
+        @serve.deployment
+        class Doubler:
+            def __call__(self, x):
+                return x * 2
+
+        @serve.deployment
+        class Adder:
+            def __call__(self, x):
+                return x + 100
+
+        @serve.deployment
+        class Combiner:
+            def __init__(self, doubler, adder):
+                self._doubler = doubler
+                self._adder = adder
+
+            def __call__(self, x):
+                d = self._doubler.remote(x).result(timeout=60)
+                a = self._adder.remote(x).result(timeout=60)
+                return {"doubled": d, "added": a}
+
+        h = serve.run(Combiner.bind(Doubler.bind(), Adder.bind()),
+                      name="combo")
+        out = h.remote(7).result(timeout=120)
+        assert out == {"doubled": 14, "added": 107}
+        # Children are addressable deployments in their own right.
+        assert serve.get_deployment_handle(
+            "Doubler").remote(3).result(timeout=60) == 6
+    finally:
+        serve.shutdown()
+        c.shutdown()
