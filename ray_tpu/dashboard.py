@@ -21,23 +21,93 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 _PAGE = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title>
-<style>body{font-family:monospace;margin:2em}pre{background:#f4f4f4;
-padding:1em}</style></head>
-<body><h2>ray_tpu cluster</h2>
-<pre id="summary">loading...</pre>
-<h3>endpoints</h3>
-<ul><li><a href="/api/summary">/api/summary</a></li>
-<li><a href="/api/nodes">/api/nodes</a></li>
-<li><a href="/api/actors">/api/actors</a></li>
-<li><a href="/api/tasks">/api/tasks</a></li>
-<li><a href="/api/workers">/api/workers</a></li>
-<li><a href="/api/jobs">/api/jobs</a></li>
-<li><a href="/metrics">/metrics</a></li></ul>
-<script>fetch('/api/summary').then(r=>r.json()).then(d=>
-document.getElementById('summary').textContent=
-JSON.stringify(d,null,2));</script>
-</body></html>"""
+<html><head><title>ray_tpu dashboard</title><meta charset="utf-8">
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5em;color:#1a1a1a}
+ h2{margin:.2em 0}h3{margin:1.2em 0 .4em;border-bottom:1px solid #ddd}
+ table{border-collapse:collapse;width:100%;font-size:13px}
+ th,td{text-align:left;padding:3px 10px;border-bottom:1px solid #eee;
+       font-family:ui-monospace,monospace;white-space:nowrap}
+ th{background:#fafafa;position:sticky;top:0}
+ .bar{display:inline-block;height:9px;background:#4a7;border-radius:2px;
+      vertical-align:middle;margin-right:4px}
+ .barbg{display:inline-block;width:90px;height:9px;background:#eee;
+        border-radius:2px;vertical-align:middle;margin-right:6px}
+ .dead{color:#c33}.alive{color:#2a7}.muted{color:#888}
+ #ts{font-size:12px;color:#888}
+ a{color:#36c;text-decoration:none}
+</style></head><body>
+<h2>ray_tpu cluster <span id="ts"></span></h2>
+<div id="summary" class="muted">loading…</div>
+<h3>Nodes</h3><table id="nodes"></table>
+<h3>Actors</h3><table id="actors"></table>
+<h3>Workers</h3><table id="workers"></table>
+<h3>Task summary</h3><table id="tasks"></table>
+<h3>Jobs</h3><table id="jobs"></table>
+<p class="muted">raw: <a href="/api/summary">summary</a> ·
+<a href="/api/nodes">nodes</a> · <a href="/api/actors">actors</a> ·
+<a href="/api/tasks">tasks</a> · <a href="/api/workers">workers</a> ·
+<a href="/api/jobs">jobs</a> · <a href="/metrics">metrics</a></p>
+<script>
+const fmt = v => typeof v === "number" && !Number.isInteger(v)
+    ? v.toFixed(2) : v;
+function table(id, rows, cols, render) {
+  const el = document.getElementById(id);
+  if (!rows || !rows.length) { el.innerHTML =
+      "<tr><td class=muted>(none)</td></tr>"; return; }
+  let h = "<tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>";
+  for (const r of rows) h += "<tr>" +
+      cols.map(c => `<td>${render(r, c)}</td>`).join("") + "</tr>";
+  el.innerHTML = h;
+}
+function usage(total, avail) {
+  const out = [];
+  for (const k of Object.keys(total || {})) {
+    const t = total[k], a = (avail || {})[k] ?? t, used = t - a;
+    const pct = t > 0 ? Math.round(100 * used / t) : 0;
+    out.push(`${k} <span class=barbg><span class=bar style="width:${
+        Math.round(pct * 0.9)}px"></span></span>${fmt(used)}/${fmt(t)}`);
+  }
+  return out.join(" &nbsp; ");
+}
+async function tick() {
+  try {
+    const [s, nodes, actors, tasks, workers, jobs] = await Promise.all(
+      ["summary","nodes","actors","tasks","workers","jobs"].map(
+        p => fetch("/api/" + p).then(r => r.json())));
+    document.getElementById("summary").textContent =
+      `nodes ${s.nodes_alive}/${s.nodes_total} · actors ${s.actors} · ` +
+      `resources ` + JSON.stringify(s.resources_available);
+    table("nodes", nodes, ["node_id","state","addr","usage","labels"],
+      (n, c) => c === "usage"
+        ? usage(n.resources_total, n.resources_available)
+        : c === "state" ? `<span class=${
+            n.state === "ALIVE" ? "alive" : "dead"}>${n.state}</span>`
+        : c === "labels" ? JSON.stringify(n.labels)
+        : JSON.stringify(n[c]).replaceAll('"', ""));
+    table("actors", actors,
+      ["actor_id","name","state","node_id","restarts"],
+      (a, c) => c === "state" ? `<span class=${
+          a.state === "ALIVE" ? "alive" : "dead"}>${a.state}</span>`
+        : a[c] ?? "");
+    const byState = {};
+    for (const t of tasks) byState[t.event] =
+        (byState[t.event] || 0) + 1;
+    table("tasks", Object.entries(byState).map(
+        ([event, count]) => ({event, count})),
+      ["event","count"], (t, c) => t[c]);
+    table("workers", workers, Object.keys(workers[0] || {}),
+      (w, c) => fmt(w[c]));
+    table("jobs", jobs, ["job_id","status","entrypoint"],
+      (j, c) => j[c] ?? "");
+    document.getElementById("ts").textContent =
+      "refreshed " + new Date().toLocaleTimeString();
+  } catch (e) {
+    document.getElementById("ts").textContent = "refresh failed: " + e;
+  }
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>"""
 
 
 class _Handler(BaseHTTPRequestHandler):

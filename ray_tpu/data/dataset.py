@@ -270,9 +270,11 @@ class Dataset:
 
     def iter_block_refs(self, window: Optional[int] = None) -> Iterator[Any]:
         from ray_tpu.data.streaming_executor import (DEFAULT_TASK_BUDGET,
-                                                     execute_topology)
+                                                     StreamingExecutor)
         budget = DEFAULT_TASK_BUDGET if window is None else max(1, window)
-        return execute_topology(self._build_states(), task_budget=budget)
+        ex = StreamingExecutor(self._build_states(), task_budget=budget)
+        self._last_executor = ex  # stats() reads the live/last metrics
+        return ex.run()
 
     def materialize(self) -> "Dataset":
         """Execute now; the result holds block refs (reference:
@@ -507,10 +509,20 @@ class Dataset:
         return self._write(path, "json", filename_prefix)
 
     def stats(self) -> Dict[str, Any]:
-        """Executed-operator metrics of the LAST full execution are not
-        retained (pull-driven executions are per-iterator); use
-        iter_block_refs on a StreamingExecutor directly for live metrics."""
-        return {"plan": [type(n).__name__ for n in self._plan]}
+        """Plan shape + per-operator metrics of the most recent execution
+        started from THIS dataset object (reference: Dataset.stats() /
+        _internal/stats.py per-op counters)."""
+        out: Dict[str, Any] = {
+            "plan": [type(n).__name__ for n in self._plan]}
+        ex = getattr(self, "_last_executor", None)
+        if ex is not None:
+            out["operators"] = {
+                name: {"inputs": m.inputs_received,
+                       "tasks_launched": m.tasks_launched,
+                       "tasks_finished": m.tasks_finished,
+                       "blocks_out": m.blocks_out}
+                for name, m in ex.metrics().items()}
+        return out
 
     def __repr__(self):
         return (f"Dataset(name={self._name!r}, "

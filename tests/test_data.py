@@ -68,10 +68,17 @@ def test_streaming_overlap(cluster):
 
     ds = rdata.range(8 * 64, num_blocks=8).map_batches(slow_stage)
     t0 = time.monotonic()
-    first = next(iter(ds.iter_batches(batch_size=None)))
-    elapsed = time.monotonic() - t0
-    assert len(first["id"]) == 64
-    assert elapsed < 8 * 0.4, f"first batch waited {elapsed:.1f}s (no overlap)"
+    it = iter(ds.iter_batches(batch_size=None))
+    first = next(it)
+    first_s = time.monotonic() - t0
+    n_rest = sum(1 for _ in it)
+    total_s = time.monotonic() - t0
+    assert len(first["id"]) == 64 and n_rest == 7
+    # Ratio, not wall clock (this 1-core host's load varies 2x): with
+    # overlap the first batch lands well before the full drain; without
+    # it, first ~= total.
+    assert first_s < 0.75 * total_s, \
+        f"first batch at {first_s:.1f}s of {total_s:.1f}s (no overlap)"
 
 
 def test_materialize_and_split(cluster):
@@ -296,3 +303,12 @@ def test_writers_roundtrip(cluster, tmp_path):
     import json
     rows = [json.loads(line) for f in js_files for line in open(f)]
     assert sorted(r["id"] for r in rows) == list(range(40))
+
+
+def test_dataset_stats_exposes_operator_metrics(cluster):
+    ds = rdata.range(40, num_blocks=4).map_batches(lambda b: b)
+    assert ds.stats()["plan"] == ["_Read", "_Fused"]
+    assert ds.count() == 40
+    ops = ds.stats()["operators"]
+    assert ops["read->map"]["tasks_launched"] == 4
+    assert ops["read->map"]["blocks_out"] == 4
