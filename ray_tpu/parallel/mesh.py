@@ -94,62 +94,83 @@ class MeshConfig:
         return MeshConfig(fsdp=n)
 
 
-def _slice_groups(devices: list, num_slices: int) -> list:
+def _slice_groups(devices: list, num_slices: int,
+                  per: Optional[int] = None) -> list:
     """Partition devices into per-slice groups. Real multi-slice TPUs
     expose `device.slice_index`; virtual/CPU meshes fall back to
-    contiguous equal chunks (the driver's 2-virtual-slice dry run)."""
-    per = len(devices) // num_slices
-    if len(devices) % num_slices:
-        raise ValueError(f"{len(devices)} devices do not split into "
-                         f"{num_slices} equal slices")
+    contiguous equal chunks (the driver's 2-virtual-slice dry run).
+
+    `per` (group size) defaults to len(devices)//num_slices; pass it
+    explicitly when `devices` is a superset to draw from (so a mesh
+    needing 6 of each 8-device physical slice isn't rejected by a
+    pre-truncated list)."""
+    if per is None:
+        if len(devices) % num_slices:
+            raise ValueError(f"{len(devices)} devices do not split into "
+                             f"{num_slices} equal slices")
+        per = len(devices) // num_slices
+    if per < 1 or len(devices) < num_slices * per:
+        raise ValueError(f"need {num_slices} slices of {per} devices, "
+                         f"have {len(devices)} devices")
     by_slice: dict = {}
-    if getattr(devices[0], "slice_index", None) is not None:
+    n_with = sum(1 for d in devices
+                 if getattr(d, "slice_index", None) is not None)
+    if n_with and n_with != len(devices):
+        raise ValueError(
+            f"mixed device list: {n_with}/{len(devices)} devices report a "
+            f"slice_index — cannot infer slice topology")
+    if n_with:
         for d in devices:
             by_slice.setdefault(d.slice_index, []).append(d)
     if by_slice:
-        # Real slice topology present: grouping must be exact. A silent
-        # contiguous fallback here would build "ICI" submeshes that
-        # straddle physical slice boundaries — a topology lie. Use the
-        # first num_slices slices (by index) that actually have enough
-        # devices, so one undersized slice can't poison the selection.
-        eligible = [k for k in sorted(by_slice)
-                    if len(by_slice[k]) >= per]
-        if len(eligible) < num_slices:
-            raise ValueError(
-                f"cannot form {num_slices} slices of {per} devices from "
-                f"physical slices "
-                f"{ {k: len(v) for k, v in by_slice.items()} } — pick DCN "
-                f"factors matching the real slice topology")
-        return [by_slice[k][:per] for k in eligible[:num_slices]]
+        # Real slice topology present: no group may STRADDLE a physical
+        # slice boundary — a straddling "ICI" submesh is a topology lie.
+        # Subdividing is fine: one physical slice with >= k*per devices
+        # yields k virtual slices (this is how the driver's
+        # jax.distributed multi-process CPU dryrun presents itself —
+        # every device reports slice_index=0). Two separate concerns:
+        #  SELECT round-robin across physical slices (depth-first would
+        #  pack every virtual slice into the lowest-indexed physical
+        #  slice and leave the others' devices out of the mesh);
+        #  ORDER the selection physical-slice-major, so the OUTERMOST
+        #  nontrivial DCN axis (np.unravel_index varies the last
+        #  coordinate fastest) is the one that truly crosses physical
+        #  slices — matching the axis doc above: pp outermost on DCN.
+        per_slice_groups = []  # [(phys_key, [groups...])] in index order
+        for k in sorted(by_slice):
+            ds = by_slice[k]
+            per_slice_groups.append(
+                (k, [ds[i * per:(i + 1) * per]
+                     for i in range(len(ds) // per)]))
+        selected: list = []  # (phys_order, depth, group)
+        depth = 0
+        while len(selected) < num_slices:
+            layer = [(order, depth, gs[depth])
+                     for order, (_, gs) in enumerate(per_slice_groups)
+                     if depth < len(gs)]
+            if not layer:
+                raise ValueError(
+                    f"cannot form {num_slices} slices of {per} devices "
+                    f"from physical slices "
+                    f"{ {k: len(v) for k, v in by_slice.items()} } "
+                    f"without straddling a slice boundary — pick DCN "
+                    f"factors matching the real slice topology")
+            selected.extend(layer)
+            depth += 1
+        selected = selected[:num_slices]
+        selected.sort(key=lambda t: (t[0], t[1]))
+        return [g for _, _, g in selected]
     # No slice identity (CPU / virtual mesh): contiguous equal chunks.
     return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
 
 
-def build_mesh(config: MeshConfig,
-               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    if devices is None:
-        devices = jax.devices()
-    n = config.num_devices
-    if n > len(devices):
-        raise ValueError(
-            f"MeshConfig {config} needs {n} devices but only {len(devices)} available")
-    devices = list(devices)[:n]
-    if config.num_slices == 1:
-        try:
-            dev_array = mesh_utils.create_device_mesh(
-                config.shape, devices=devices, allow_split_physical_axes=True)
-        except Exception:
-            dev_array = np.array(devices).reshape(config.shape)
-        return Mesh(dev_array, AXIS_NAMES)
-
-    # Multi-slice (DCN) mesh: per-slice ICI submeshes composed so each
-    # axis's slice-crossing factor is OUTERMOST within the axis (the
-    # layout jax.experimental.mesh_utils.create_hybrid_device_mesh
-    # produces; built manually so virtual CPU slices — no slice_index —
-    # work identically for the multi-chip dry run).
+def _merge_hybrid(groups: list, config: "MeshConfig") -> Mesh:
+    """Compose per-slice ICI submeshes into the hybrid mesh: each axis's
+    slice-crossing (DCN) factor is OUTERMOST within the axis — the layout
+    mesh_utils.create_hybrid_device_mesh produces, built manually so
+    virtual CPU slices work identically for the multi-chip dry run."""
     ici_shape = config.ici_shape
     dcn_shape = config.dcn_shape
-    groups = _slice_groups(devices, config.num_slices)
     slice_arrays = []
     for g in groups:
         try:
@@ -166,6 +187,34 @@ def build_mesh(config: MeshConfig,
     k = len(AXIS_NAMES)
     arr = arr.transpose([ax for i in range(k) for ax in (i, k + i)])
     return Mesh(arr.reshape(config.shape), AXIS_NAMES)
+
+
+def build_mesh(config: MeshConfig,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = config.num_devices
+    if n > len(devices):
+        raise ValueError(
+            f"MeshConfig {config} needs {n} devices but only {len(devices)} available")
+    devices = list(devices)
+    if config.num_slices == 1:
+        devices = devices[:n]
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                config.shape, devices=devices, allow_split_physical_axes=True)
+        except Exception:
+            dev_array = np.array(devices).reshape(config.shape)
+        return Mesh(dev_array, AXIS_NAMES)
+
+    # Multi-slice (DCN) mesh. Validate axis/DCN divisibility up front
+    # (ici_shape raises the precise error; per = prod(ici_shape) >= 1
+    # follows), then group from the FULL device list (not a [:n]
+    # truncation) so a mesh needing, say, 6 devices from each of two
+    # 8-device physical slices is satisfiable.
+    per = math.prod(config.ici_shape)
+    groups = _slice_groups(devices, config.num_slices, per=per)
+    return _merge_hybrid(groups, config)
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
