@@ -172,33 +172,35 @@ int store_create_object(void* handle, const char* id, uint64_t data_size,
 // Ingest a fully-written payload file as a SEALED object in one step
 // (worker writes <dir>/ingest-* directly, then one RPC lands here —
 // halves the control round-trips of the create+write+seal protocol).
+// The rename happens UNDER the mutex, before the entry is published:
+// otherwise a concurrent EvictFor could pick the just-inserted entry
+// (refcount 0, unpinned) as a victim and erase it before the rename
+// lands — the caller would get rc=0 for an object that is gone, with
+// the renamed payload stranded untracked in the store dir. A tmpfs
+// rename is a metadata-only op, so holding the lock across it is cheap.
+// `pinned` != 0 admits the object as a pinned PRIMARY copy atomically,
+// so the agent's pin cannot race with eviction either.
 // 0 ok, -1 already exists, -2 out of memory (after eviction), -3 io error.
 int store_ingest_object(void* handle, const char* id, const char* src_path,
-                        uint64_t data_size, uint64_t meta_size) {
+                        uint64_t data_size, uint64_t meta_size, int pinned) {
   auto* s = static_cast<Store*>(handle);
   std::string key = IdKey(id);
   uint64_t total = data_size + meta_size;
-  std::string path;
-  {
-    std::lock_guard<std::mutex> g(s->mu);
-    if (s->objects.count(key)) return -1;
-    if (total > s->capacity) return -2;
-    if (!EvictFor(s, total)) return -2;
-    path = HexPath(*s, key);
-    ObjectEntry e;
-    e.path = path;
-    e.data_size = data_size;
-    e.meta_size = meta_size;
-    e.sealed = true;
-    s->used += total;
-    auto ins = s->objects.emplace(key, std::move(e));
-    LruPush(s, key, &ins.first->second);
-  }
-  if (::rename(src_path, path.c_str()) != 0) {
-    std::lock_guard<std::mutex> g(s->mu);
-    EraseObject(s, key);
-    return -3;
-  }
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->objects.count(key)) return -1;
+  if (total > s->capacity) return -2;
+  if (!EvictFor(s, total)) return -2;
+  std::string path = HexPath(*s, key);
+  if (::rename(src_path, path.c_str()) != 0) return -3;
+  ObjectEntry e;
+  e.path = path;
+  e.data_size = data_size;
+  e.meta_size = meta_size;
+  e.sealed = true;
+  e.pinned = pinned != 0;
+  s->used += total;
+  auto ins = s->objects.emplace(key, std::move(e));
+  if (!ins.first->second.pinned) LruPush(s, key, &ins.first->second);
   return 0;
 }
 

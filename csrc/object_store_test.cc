@@ -12,6 +12,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <atomic>
 #include <string>
 #include <thread>
 #include <vector>
@@ -26,7 +27,7 @@ void store_destroy(void* handle);
 int store_create_object(void* handle, const char* id, uint64_t data_size,
                         uint64_t meta_size, char* out_path, int path_cap);
 int store_ingest_object(void* handle, const char* id, const char* src_path,
-                        uint64_t data_size, uint64_t meta_size);
+                        uint64_t data_size, uint64_t meta_size, int pinned);
 int store_seal(void* handle, const char* id);
 int store_get(void* handle, const char* id, char* out_path, int path_cap,
               uint64_t* data_size, uint64_t* meta_size);
@@ -140,7 +141,7 @@ void TestIngestAdoptsSealed() {
   std::string src = dir + "/ingest-test-1";
   WriteFile(src, "hello-ingest");
   std::string id = MakeId('i');
-  assert(store_ingest_object(s, id.c_str(), src.c_str(), 12, 0) == 0);
+  assert(store_ingest_object(s, id.c_str(), src.c_str(), 12, 0, 0) == 0);
   assert(!FileExists(src));  // renamed in, not copied
   assert(store_contains(s, id.c_str()) == 1);  // sealed on arrival
   char path[4096];
@@ -154,12 +155,80 @@ void TestIngestAdoptsSealed() {
   assert(std::memcmp(buf, "hello-ingest", 12) == 0);
   // Duplicate ingest is rejected; over-capacity ingest leaves src alone.
   WriteFile(src, "x");
-  assert(store_ingest_object(s, id.c_str(), src.c_str(), 1, 0) == -1);
+  assert(store_ingest_object(s, id.c_str(), src.c_str(), 1, 0, 0) == -1);
   std::string big = MakeId('j');
-  assert(store_ingest_object(s, big.c_str(), src.c_str(), 4096, 0) == -2);
+  assert(store_ingest_object(s, big.c_str(), src.c_str(), 4096, 0, 0) == -2);
   assert(FileExists(src));  // caller's cleanup problem, not clobbered
   store_destroy(s);
   std::printf("  ingest OK\n");
+}
+
+void TestIngestPinnedSurvivesPressure() {
+  // A pinned ingest is admitted atomically as a primary copy: capacity
+  // pressure right after admission must evict OTHER unpinned objects,
+  // never the fresh ingest (the r4 advisor race: sealed+unpinned entry
+  // published before the rename could be evicted mid-ingest).
+  std::string dir = TempDir("ingest-pin");
+  void* s = store_create(dir.c_str(), 300);
+  std::string src = dir + "/ingest-p-1";
+  WriteFile(src, std::string(200, 'p'));
+  std::string id = MakeId('p');
+  assert(store_ingest_object(s, id.c_str(), src.c_str(), 200, 0, 1) == 0);
+  // Filling the remaining 100 bytes forces eviction; the pinned ingest
+  // must not be a victim, so a 200-byte create cannot fit.
+  char path[4096];
+  std::string q = MakeId('q');
+  assert(store_create_object(s, q.c_str(), 200, 0, path, sizeof path) == -2);
+  assert(store_contains(s, id.c_str()) == 1);
+  // Unpinned ingest IS evictable under pressure.
+  std::string src2 = dir + "/ingest-p-2";
+  WriteFile(src2, std::string(50, 'u'));
+  std::string u = MakeId('u');
+  assert(store_ingest_object(s, u.c_str(), src2.c_str(), 50, 0, 0) == 0);
+  assert(store_create_object(s, q.c_str(), 100, 0, path, sizeof path) == 0);
+  assert(store_contains(s, u.c_str()) == 0);  // evicted
+  assert(store_contains(s, id.c_str()) == 1);  // pinned survives
+  store_destroy(s);
+  std::printf("  ingest-pinned OK\n");
+}
+
+void TestConcurrentIngestEvict() {
+  // Hammer ingest (pinned) + delete from several threads against a small
+  // capacity: every rc=0 ingest must leave a readable object (the race
+  // fixed in r5: rename outside the mutex let EvictFor erase the entry
+  // first, acknowledging a put for a vanished object).
+  std::string dir = TempDir("ingest-race");
+  void* s = store_create(dir.c_str(), 1 << 16);
+  std::atomic<int> bad{0};
+  auto worker = [&](int t) {
+    char path[4096];
+    uint64_t ds, ms;
+    for (int i = 0; i < 100; i++) {
+      std::string src = dir + "/ingest-t" + std::to_string(t) + "-" +
+                        std::to_string(i);
+      WriteFile(src, std::string(512, (char)('a' + t)));
+      std::string id(20, (char)('a' + t));
+      id[19] = (char)('0' + (i % 10));
+      store_delete(s, id.c_str());
+      if (store_ingest_object(s, id.c_str(), src.c_str(), 512, 0, 1) == 0) {
+        if (store_get(s, id.c_str(), path, sizeof path, &ds, &ms) != 0 ||
+            !FileExists(path)) {
+          bad.fetch_add(1);
+        } else {
+          store_release(s, id.c_str());
+        }
+        store_pin(s, id.c_str(), 0);
+      } else {
+        ::unlink(src.c_str());
+      }
+    }
+  };
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; t++) ts.emplace_back(worker, t);
+  for (auto& th : ts) th.join();
+  assert(bad.load() == 0);
+  store_destroy(s);
+  std::printf("  ingest-concurrent OK\n");
 }
 
 void TestConcurrentCreateRelease() {
@@ -197,6 +266,8 @@ int main() {
   TestCreateSealGetLifecycle();
   TestEvictionRespectsPinsAndRefs();
   TestIngestAdoptsSealed();
+  TestIngestPinnedSurvivesPressure();
+  TestConcurrentIngestEvict();
   TestConcurrentCreateRelease();
   std::printf("object_store_test: ALL OK\n");
   return 0;

@@ -47,6 +47,11 @@ class NodeProvider:
     def node_port(self, handle: Any) -> Optional[int]:
         return None
 
+    def handle_failed(self, handle: Any) -> bool:
+        """True if this launch is known-dead (will never register) — the
+        autoscaler drops such handles and can retry the scale-up."""
+        return False
+
 
 class LocalNodeProvider(NodeProvider):
     """Spawns node agents on this host (reference:
@@ -136,13 +141,17 @@ class TPUPodProvider(NodeProvider):
             out.append(part)
         return out
 
-    def _launch(self, cmd: List[str], what: str):
+    def _launch(self, cmd: List[str], what: str,
+                handle: Optional[dict] = None):
         """Start the cloud CLI WITHOUT blocking the reconcile thread
         (slice create/delete takes minutes; the reference's instance
         manager is similarly asynchronous). An immediately-failing
         command (bad binary/flags) still raises here; a background
-        reaper wait()s the child (no zombies) and drops the log on
-        success (failures keep theirs for debugging, with a warning)."""
+        reaper wait()s the child (no zombies), drops the log on success,
+        and marks `handle['failed']` on a late nonzero exit (quota,
+        capacity, auth) so the autoscaler's reconcile can drop the
+        handle and retry instead of waiting forever on a node that will
+        never register."""
         import tempfile
         import threading
         log = tempfile.NamedTemporaryFile(
@@ -172,6 +181,8 @@ class TPUPodProvider(NodeProvider):
                 except OSError:
                     pass
             else:
+                if handle is not None:
+                    handle["failed"] = True
                 logger.warning("TPU slice %s exited rc=%d (log: %s)",
                                what, rc, log.name)
 
@@ -182,13 +193,18 @@ class TPUPodProvider(NodeProvider):
     def create_node(self, resources: Dict[str, float]):
         self._seq += 1
         name = f"{self._prefix}-{self._seq}"
-        proc = self._launch(self._fmt(self._create_cmd, name), "create")
+        handle = {"name": name, "port": self.AGENT_PORT, "failed": False}
+        handle["proc"] = self._launch(self._fmt(self._create_cmd, name),
+                                      "create", handle=handle)
         logger.info("creating TPU slice %s (%s in %s)", name, self._acc,
                     self._zone)
-        return {"name": name, "port": self.AGENT_PORT, "proc": proc}
+        return handle
 
     def node_port(self, handle) -> Optional[int]:
         return handle.get("port")
+
+    def handle_failed(self, handle) -> bool:
+        return bool(handle.get("failed"))
 
     def terminate_node(self, handle) -> None:
         try:
@@ -242,6 +258,23 @@ class Autoscaler:
 
     def update(self) -> Optional[str]:
         """One reconcile tick; returns the action taken (for tests)."""
+        # Drop launches the provider knows are dead (create failed after
+        # the fail-fast window) so their capacity doesn't suppress the
+        # next scale-up forever.
+        dead = [h for h in self._launched
+                if self._provider.handle_failed(h)]
+        for h in dead:
+            logger.warning("dropping failed node launch %s",
+                           h.get("name", h) if isinstance(h, dict) else h)
+            self._launched.remove(h)
+            # Best-effort terminate: a late create failure may still have
+            # provisioned the cloud resource (e.g. the VM came up but the
+            # startup script failed) — never leak it. Providers treat
+            # deleting a nonexistent node as a quiet no-op.
+            try:
+                self._provider.terminate_node(h)
+            except Exception as e:
+                logger.warning("terminate of failed launch: %r", e)
         st = self._state()
         alive = [n for n in st["nodes"] if n["state"] == "ALIVE"]
         # Correlate launched handles with registered nodes by agent port

@@ -1009,8 +1009,8 @@ class NodeAgent:
             except OSError:
                 pass
             raise
-        # Same bookkeeping as store_seal: primary pin + seal waiters.
-        self.store.pin(o)
+        # ingest() admitted the object already pinned (atomic primary
+        # admission); only the ledger + seal waiters remain.
         self._primary[oid] = data_size + meta_size
         ev = self._seal_waiters.pop(oid, None)
         if ev:

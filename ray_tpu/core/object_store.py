@@ -71,7 +71,7 @@ def _load_lib() -> ctypes.CDLL:
     lib.store_ingest_object.restype = ctypes.c_int
     lib.store_ingest_object.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
-        ctypes.c_uint64]
+        ctypes.c_uint64, ctypes.c_int]
     lib.store_get.restype = ctypes.c_int
     lib.store_get.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
@@ -133,13 +133,16 @@ class LocalObjectStore:
         return self._dir
 
     def ingest(self, oid: ObjectID, src_path: str, data_size: int,
-               meta_size: int = 0) -> None:
+               meta_size: int = 0, pinned: bool = True) -> None:
         """Adopt a fully-written payload file as a sealed object (the
         one-RPC put path: the writer produced src_path in the store dir;
-        the store accounts, evicts if needed, and renames it in)."""
+        the store accounts, evicts if needed, and renames it in under the
+        store mutex). `pinned` admits it atomically as a primary copy, so
+        a concurrent eviction can never take it between admission and the
+        agent's pin (r4 advisor finding)."""
         rc = self._lib.store_ingest_object(
             self._handle, oid.binary(), src_path.encode(), data_size,
-            meta_size)
+            meta_size, 1 if pinned else 0)
         if rc == -1:
             raise FileExistsError(f"object exists: {oid}")
         if rc == -2:

@@ -338,7 +338,14 @@ class AllToAllOperator(PhysicalOperator):
     """Barrier operator: collects EVERY input ref, then runs a driver-side
     exchange function refs -> refs (hash shuffle, sort, repartition)
     (reference: base_physical_operator.py AllToAllOperator; the exchange
-    fns themselves stay the two-wave task pipelines in shuffle.py)."""
+    fns themselves stay the two-wave task pipelines in shuffle.py).
+
+    The exchange runs on a worker THREAD launched by dispatch() — the
+    exchange fns block on their barrier task waves, and running them on
+    the executor loop would stall harvesting/dispatch for every
+    independent operator (e.g. the other branch of a union) while the
+    barrier runs. Driver API calls are thread-safe (the core worker
+    marshals them onto its IO loop)."""
 
     def __init__(self, exchange_fn: Callable[[List[Any]], List[Any]],
                  name: str = "all_to_all"):
@@ -346,38 +353,66 @@ class AllToAllOperator(PhysicalOperator):
         self._exchange_fn = exchange_fn
         self._collected: List[Any] = []
         self._emitted = False
-        self._running = False
+        self._thread = None
+        self._result: Optional[List[Any]] = None
+        self._error: Optional[BaseException] = None
 
     def can_dispatch(self) -> bool:
         # Runs exactly once, only after the full input set arrived.
-        return self._inputs_done and not self._emitted and not self._running
+        return (self._inputs_done and not self._emitted
+                and self._thread is None)
 
     def dispatch(self) -> bool:
         if not self.can_dispatch():
             return False
+        import threading
         self._collected.extend(self._input_queue)
         self._input_queue.clear()
-        self._running = True
+
+        def _run():
+            try:
+                self._result = list(self._exchange_fn(self._collected))
+            except BaseException as e:  # surfaced from poll()
+                self._error = e
+
+        self._thread = threading.Thread(
+            target=_run, name=f"data-{self.name}", daemon=True)
+        self._thread.start()
         self.metrics.tasks_launched += 1
         return True
 
     def num_active_tasks(self) -> int:
-        return 1 if self._running else 0
+        return 1 if (self._thread is not None
+                     and not self._emitted) else 0
 
     def poll(self) -> List[Any]:
-        if self._input_queue and not self._running:
+        if self._thread is None:
             # keep collecting as inputs stream in
             self._collected.extend(self._input_queue)
             self._input_queue.clear()
-        if not self._running:
             return []
-        out = list(self._exchange_fn(self._collected))
+        if self._thread.is_alive():
+            return []
+        self._thread.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            self._emitted = True
+            raise err
+        if self._emitted:
+            return []
+        out = self._result or []
+        self._result = None
         self._collected = []
-        self._running = False
         self._emitted = True
         self.metrics.tasks_finished += 1
         self.metrics.blocks_out += len(out)
         return out
+
+    def wait_any(self, timeout: float) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout)
+        else:
+            super().wait_any(timeout)
 
     def completed(self) -> bool:
         return self._emitted

@@ -189,6 +189,33 @@ def _merge_hybrid(groups: list, config: "MeshConfig") -> Mesh:
     return Mesh(arr.reshape(config.shape), AXIS_NAMES)
 
 
+def _select_single_slice(devices: list, n: int) -> list:
+    """Pick n devices for a single-slice (all-ICI) mesh. When the devices
+    carry real slice topology, prefer a single physical slice — a
+    truncation that straddles slices would label DCN hops as ICI. If no
+    one slice holds n devices, the mesh genuinely spans slices: warn
+    (collectives on every axis will ride DCN; set dcn_* factors to split
+    the low-bandwidth axes deliberately) and fall back to the first n."""
+    if len(devices) == n or getattr(devices[0], "slice_index", None) is None:
+        return devices[:n]
+    by_slice: dict = {}
+    for d in devices:
+        si = getattr(d, "slice_index", None)
+        if si is None:
+            return devices[:n]  # mixed: no usable topology signal
+        by_slice.setdefault(si, []).append(d)
+    for k in sorted(by_slice):
+        if len(by_slice[k]) >= n:
+            return by_slice[k][:n]
+    from ray_tpu.utils import get_logger
+    get_logger("mesh").warning(
+        "single-slice mesh of %d devices spans %d physical slices — every "
+        "axis's collectives will cross DCN; set MeshConfig dcn_* factors "
+        "to place only low-bandwidth axes (dp/fsdp/pp) across slices",
+        n, len(by_slice))
+    return devices[:n]
+
+
 def build_mesh(config: MeshConfig,
                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     if devices is None:
@@ -199,7 +226,7 @@ def build_mesh(config: MeshConfig,
             f"MeshConfig {config} needs {n} devices but only {len(devices)} available")
     devices = list(devices)
     if config.num_slices == 1:
-        devices = devices[:n]
+        devices = _select_single_slice(devices, n)
         try:
             dev_array = mesh_utils.create_device_mesh(
                 config.shape, devices=devices, allow_split_physical_axes=True)

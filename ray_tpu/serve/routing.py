@@ -6,6 +6,7 @@ deployment handles off one controller-fed table)."""
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, Optional
 
@@ -16,7 +17,11 @@ from ray_tpu.serve.handle import DeploymentHandle
 class RouteTable:
     """route_prefix -> deployment resolution + handle cache. Refreshes
     are rate-limited (negative cache) so unknown-path probes can't
-    hammer the controller."""
+    hammer the controller.
+
+    Shared across the HTTP proxy's and gRPC proxy's thread pools: the
+    refresh claim and the handle cache are lock-guarded (the routes dict
+    itself is replaced atomically, so match() reads lock-free)."""
 
     _NEG_CACHE_TTL_S = 2.0
 
@@ -25,6 +30,7 @@ class RouteTable:
         self._routes: Dict[str, str] = {}
         self._handles: Dict[str, DeploymentHandle] = {}
         self._last_refresh = 0.0
+        self._lock = threading.Lock()
 
     @property
     def routes(self) -> Dict[str, str]:
@@ -44,20 +50,26 @@ class RouteTable:
 
     def match(self, path: str) -> Optional[str]:
         """Longest-prefix route match -> deployment name (no refresh)."""
-        best = max((p for p in self._routes
+        routes = self._routes  # snapshot: refresh() swaps the dict
+        best = max((p for p in routes
                     if path == p or path.startswith(p + "/")),
                    key=len, default=None)
-        return self._routes[best] if best is not None else None
+        return routes[best] if best is not None else None
 
     def should_refresh(self) -> bool:
+        """Atomically claim the next refresh window (at most one caller
+        per TTL gets True)."""
         now = time.monotonic()
-        if now - self._last_refresh > self._NEG_CACHE_TTL_S:
-            self._last_refresh = now
-            return True
-        return False
+        with self._lock:
+            if now - self._last_refresh > self._NEG_CACHE_TTL_S:
+                self._last_refresh = now
+                return True
+            return False
 
     def handle_for(self, deployment: str) -> DeploymentHandle:
-        if deployment not in self._handles:
-            self._handles[deployment] = DeploymentHandle(
-                deployment, self._controller)
-        return self._handles[deployment]
+        with self._lock:
+            h = self._handles.get(deployment)
+            if h is None:
+                h = self._handles[deployment] = DeploymentHandle(
+                    deployment, self._controller)
+            return h

@@ -119,3 +119,94 @@ def test_tpu_pod_provider_command_templates(tmp_path):
         create_cmd=["false"], delete_cmd=["true"])
     with pytest.raises(RuntimeError):
         bad.create_node({})
+
+
+def test_tpu_pod_provider_late_failure_marks_handle():
+    """A create that fails AFTER the fail-fast window (quota/capacity/auth)
+    must mark the handle failed so the autoscaler can drop it and retry
+    — otherwise the phantom launch suppresses scale-up forever."""
+    from ray_tpu.autoscaler import TPUPodProvider
+
+    provider = TPUPodProvider(
+        zone="z", accelerator_type="a", controller_addr=("h", 1),
+        create_cmd=["python", "-c", "import time; time.sleep(0.5); "
+                                    "raise SystemExit(1)"],
+        delete_cmd=["true"])
+    h = provider.create_node({})
+    assert not provider.handle_failed(h)  # still in flight
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not provider.handle_failed(h):
+        time.sleep(0.05)
+    assert provider.handle_failed(h)
+
+
+def test_tpu_pod_provider_delete_gone_slice_is_quiet():
+    """Deleting an already-gone slice (cloud returns nonzero) must not
+    raise — termination is idempotent from the autoscaler's view."""
+    from ray_tpu.autoscaler import TPUPodProvider
+
+    provider = TPUPodProvider(
+        zone="z", accelerator_type="a", controller_addr=("h", 1),
+        create_cmd=["true"], delete_cmd=["false"])
+    provider.terminate_node({"name": "gone", "port": 1})  # no raise
+
+
+def test_autoscaler_drops_failed_launches():
+    """Autoscaler.update() prunes handles the provider marks failed, so
+    the failed launch's capacity stops suppressing the next scale-up."""
+    from ray_tpu.autoscaler import Autoscaler, NodeProvider
+
+    class P(NodeProvider):
+        def __init__(self):
+            self.created = 0
+
+        def create_node(self, resources):
+            self.created += 1
+            return {"name": f"n{self.created}", "failed": self.created == 1}
+
+        def handle_failed(self, handle):
+            return handle.get("failed", False)
+
+        def terminate_node(self, handle):
+            pass
+
+    class _FakeFut:
+        def __init__(self, v):
+            self._v = v
+
+        def result(self, timeout=None):
+            return self._v
+
+    class _FakeCW:
+        """Stub core worker: one alive node with zero capacity + one
+        pending actor demand -> always wants a scale-up."""
+
+        class controller:
+            @staticmethod
+            def call(method, *a):
+                return method
+
+        def _run(self, method):
+            if method == "autoscaler_state":
+                return _FakeFut({
+                    "nodes": [{"node_id": "head", "state": "ALIVE",
+                               "available": {"CPU": 0.0},
+                               "total": {"CPU": 1.0}}],
+                    "pending_actors": [{"CPU": 1.0}],
+                    "pending_pg_bundles": [], "infeasible": []})
+            return _FakeFut([{"node_id": "head", "addr": ("h", 1)}])
+
+    scaler = Autoscaler.__new__(Autoscaler)
+    provider = P()
+    scaler._cw = _FakeCW()
+    scaler._provider = provider
+    scaler._node_resources = {"CPU": 4.0}
+    scaler._min, scaler._max = 0, 4
+    scaler._idle_timeout, scaler._period = 30.0, 1.0
+    scaler._launched, scaler._idle_since = [], {}
+
+    assert scaler.update() == "up"        # launch 1 (will fail)
+    assert len(scaler._launched) == 1
+    assert scaler.update() == "up"        # prunes failed, retries
+    assert provider.created == 2
+    assert [h["name"] for h in scaler._launched] == ["n2"]

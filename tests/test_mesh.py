@@ -202,6 +202,17 @@ def test_multi_axis_dcn_outermost_crosses_physical():
                 arr[pp_i, 1, 0, 0, 0, 0].slice_index)
 
 
+def test_single_slice_mesh_prefers_one_physical_slice():
+    """num_slices==1 with real slice topology: select from ONE physical
+    slice instead of a [:n] truncation that straddles (DCN mislabeled as
+    ICI). Slice 0 has only 4 devices, so an 8-device mesh must come
+    entirely from slice 1."""
+    devs = [_FakeDev(i, 0) for i in range(4)] + \
+           [_FakeDev(i, 1) for i in range(4, 12)]
+    mesh = build_mesh(MeshConfig(dp=8), devices=devs)
+    assert {d.slice_index for d in mesh.devices.flat} == {1}
+
+
 def test_slice_groups_mixed_devices_rejected():
     from ray_tpu.parallel.mesh import _slice_groups
 
