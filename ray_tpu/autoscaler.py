@@ -235,6 +235,11 @@ class Autoscaler:
         self._idle_since: Dict[bytes, float] = {}
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # Exponential backoff after failed launches, so a persistent
+        # cloud failure (quota exhausted) doesn't become an endless
+        # create+delete CLI pair every tick.
+        self._failure_backoff_s = 0.0
+        self._next_launch_at = 0.0
 
     # -- scheduling math -------------------------------------------------
     @staticmethod
@@ -275,6 +280,12 @@ class Autoscaler:
                 self._provider.terminate_node(h)
             except Exception as e:
                 logger.warning("terminate of failed launch: %r", e)
+        if dead:
+            self._failure_backoff_s = min(
+                300.0, max(2.0, self._failure_backoff_s * 2))
+            self._next_launch_at = time.time() + self._failure_backoff_s
+            logger.warning("launch backoff %.0fs after failure",
+                           self._failure_backoff_s)
         st = self._state()
         alive = [n for n in st["nodes"] if n["state"] == "ALIVE"]
         # Correlate launched handles with registered nodes by agent port
@@ -290,11 +301,19 @@ class Autoscaler:
             port = self._provider.node_port(h)
             if port is not None:
                 handles_by_port[port] = h
+        # A launched node registering ALIVE proves the provider works
+        # again: clear the failure backoff.
+        if self._failure_backoff_s and any(
+                node_addr_ports.get(n["node_id"]) in handles_by_port
+                for n in alive):
+            self._failure_backoff_s = 0.0
+            self._next_launch_at = 0.0
         demands = (st["pending_actors"] + st["pending_pg_bundles"]
                    + st["infeasible"])
         demands = [d for d in demands if d]
         unmet = self._bin_packs(demands, [n["available"] for n in alive])
-        if unmet and len(alive) < self._max:
+        if unmet and len(alive) < self._max \
+                and time.time() >= self._next_launch_at:
             # One node per tick (the reference batches; conservative here).
             fits_new = self._bin_packs(unmet, [self._node_resources])
             if len(fits_new) < len(unmet):

@@ -196,7 +196,7 @@ def _select_single_slice(devices: list, n: int) -> list:
     one slice holds n devices, the mesh genuinely spans slices: warn
     (collectives on every axis will ride DCN; set dcn_* factors to split
     the low-bandwidth axes deliberately) and fall back to the first n."""
-    if len(devices) == n or getattr(devices[0], "slice_index", None) is None:
+    if getattr(devices[0], "slice_index", None) is None:
         return devices[:n]
     by_slice: dict = {}
     for d in devices:

@@ -1,20 +1,34 @@
-"""Continuous-batching LLM decode engine with a slotted (paged) KV arena.
+"""Continuous-batching LLM decode engine with a PAGED KV cache.
 
 The TPU-native answer to the reference's vLLM delegation (reference:
 python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_models.py:170 —
-engine_kwargs feed vLLM's continuous batcher; here the engine is OURS):
+engine_kwargs feed vLLM's continuous batcher + paged attention; here the
+engine is OURS):
 
-- **Static KV arena** `[n_layers, n_slots, max_seq, kv_heads, head_dim]`
-  — the "pages" are per-request slots of a statically-shaped arena, so
-  every step is one fixed-shape XLA program (no recompiles, MXU-batched
-  across requests).
-- **Continuous batching**: one background decode loop per replica admits
-  new requests into free slots (prefill) and evicts finished ones
-  between chunks; in-flight requests never wait for each other's
-  completion — aggregate tokens/s scales with occupancy.
-- **Chunked decode**: `decode_chunk` tokens per host sync
-  (`lax.fori_loop` on device), the same latency/throughput dial the
-  single-stream path used.
+- **Paged KV arena** `[n_layers, n_pages, page, kv_heads, head_dim]` with
+  a per-slot BLOCK TABLE `[n_slots, max_pages]` of physical page ids —
+  vLLM's block-table design recast for XLA: the table is a device array,
+  reads are one gather per layer (`kc[bt]`), writes are one scatter at
+  each slot's position. A 50-token request holds ceil(50/page) pages, not
+  a max_seq strip, so concurrency is bounded by TOKENS in flight, not by
+  worst-case sequences. Page 0 is the NULL page: unused/overflow table
+  entries point at it, making out-of-reservation writes harmless and
+  gathers of unused pages maskable — no data-dependent control flow.
+- **Reservation admission**: a request is admitted when
+  ceil(min(len+max_tokens, max_seq)/page) free pages exist — growth can
+  then never fail mid-decode, so there is no preemption/recompute path
+  (vLLM's watermark policy, made strict). Requests queue FIFO while
+  pages are short; finishing requests return their pages.
+- **Sync-free dispatch loop + emitter thread**: the engine loop ONLY
+  dispatches device work (prefills, decode chunks, slot pokes) — every
+  host<->device sync (fetching first tokens and chunk outputs) happens
+  on a separate EMITTER thread consuming a bounded FIFO. Slot/page
+  control state advances deterministically on the host (token VALUES
+  are the only device-dependent output), so chunks dispatch
+  back-to-back and admissions slot in mid-pipeline; the tunnel/host
+  round-trip is paid off the critical path. The FIFO bound (see
+  `_emit_q`) is the pipeline depth. One fixed-shape XLA program serves
+  every step (no recompiles).
 
 A small fixed set of compiled programs serves all traffic: one prefill
 per power-of-2 BUCKET width (a short prompt pays a short prefill — the
@@ -26,6 +40,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 
@@ -85,7 +100,7 @@ def _make_prefill_core(mcfg):
     return core
 
 
-def _build_fns(mcfg, n_slots: int, chunk: int):
+def _build_fns(mcfg, n_slots: int, chunk: int, page: int, n_pages: int):
     """Build (prefill_jit, decode_jit, adopt_jit, empty_caches)."""
     import jax
     import jax.numpy as jnp
@@ -97,36 +112,48 @@ def _build_fns(mcfg, n_slots: int, chunk: int):
 
     S = mcfg.max_seq
     H, KVH, hd = mcfg.n_heads, mcfg.n_kv_heads, mcfg.head_dim
-    D = mcfg.d_model
     dt = mcfg.dtype
     ns = n_slots
+    maxp = -(-S // page)          # logical pages per slot
+    CTX = maxp * page             # gathered context width (>= S)
 
     def empty_caches():
-        shape = (mcfg.n_layers, ns, S, KVH, hd)
+        shape = (mcfg.n_layers, n_pages, page, KVH, hd)
         return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
+    def _write_pages(kc, vc, pages, ks, vs):
+        """Scatter prefilled [L, W, KVH, hd] k/v into physical pages.
+        W is static (one program per bucket width); `pages[:wp]` entries
+        of 0 route padding into the null page."""
+        L, W = ks.shape[0], ks.shape[1]
+        wp = -(-W // page)
+        pad = wp * page - W
+        ksp = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vsp = jnp.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        ksp = ksp.reshape(L, wp, page, KVH, hd)
+        vsp = vsp.reshape(L, wp, page, KVH, hd)
+        kc = kc.at[:, pages[:wp]].set(ksp)
+        vc = vc.at[:, pages[:wp]].set(vsp)
+        return kc, vc
+
     # ------------------------------------------------------------------
-    # prefill: full causal pass over ONE padded prompt, caching k/v
+    # prefill: full causal pass over ONE padded prompt, k/v -> pages
     # ------------------------------------------------------------------
     _core = _make_prefill_core(mcfg)
 
-    def prefill(params, kc, vc, slot, tokens, length):
+    def prefill(params, kc, vc, pages, tokens, length):
         """tokens [1, B] padded to a BUCKET width (powers of 2 up to
         max_seq — jax.jit compiles one program per bucket shape, so a
         short prompt pays a short prefill, not a max_seq one); writes
-        slot's k/v, returns the first generated token (greedy)."""
+        the slot's pages, returns the first generated token (greedy)."""
         first, ks, vs = _core(params, tokens, length)
-        # ks/vs: [L, B, KVH, hd] -> arena slot (dynamic slot index)
-        kc = jax.lax.dynamic_update_slice(kc, ks[:, None], (0, slot, 0, 0, 0))
-        vc = jax.lax.dynamic_update_slice(vc, vs[:, None], (0, slot, 0, 0, 0))
+        kc, vc = _write_pages(kc, vc, pages, ks, vs)
         return kc, vc, first
 
-    def adopt(kc, vc, slot, ks, vs):
+    def adopt(kc, vc, pages, ks, vs):
         """Write externally-prefilled k/v (a PrefillServer handoff) into
-        a slot of the arena."""
-        kc = jax.lax.dynamic_update_slice(kc, ks[:, None], (0, slot, 0, 0, 0))
-        vc = jax.lax.dynamic_update_slice(vc, vs[:, None], (0, slot, 0, 0, 0))
-        return kc, vc
+        the slot's pages."""
+        return _write_pages(kc, vc, pages, ks, vs)
 
     # ------------------------------------------------------------------
     # decode: one token for every active slot per step, `chunk` steps
@@ -137,8 +164,8 @@ def _build_fns(mcfg, n_slots: int, chunk: int):
         out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
         return out.astype(x.dtype)
 
-    def _decode_layer(x, lp, kc_l, vc_l, pos, act, cos, sin):
-        # x [ns, D]; kc_l/vc_l [ns, S, KVH, hd]; pos [ns]; act [ns] bool
+    def _decode_layer(x, lp, kc_l, vc_l, bt, pos, act, cos, sin):
+        # x [ns, D]; kc_l/vc_l [n_pages, page, KVH, hd]; bt [ns, maxp]
         h = rms_norm(x, lp["attn_norm"], mcfg.norm_eps)
         q = (h @ lp["wq"].astype(dt)).reshape(ns, H, hd)
         k = (h @ lp["wk"].astype(dt)).reshape(ns, KVH, hd)
@@ -148,22 +175,27 @@ def _build_fns(mcfg, n_slots: int, chunk: int):
         s = sin[w][:, None]
         q = _rope_one(q, c, s)
         k = _rope_one(k, c, s)
-        # Write k/v at each slot's position — inactive slots keep the old
-        # value (no-op write keeps the shape static).
+        # Scatter k/v at each slot's (page, offset). Inactive slots (and
+        # positions past a slot's reservation) route to the NULL page 0,
+        # whose content is never read unmasked — the write stays a
+        # fixed-shape scatter with no data-dependent branches.
         idx = jnp.arange(ns)
-        k_eff = jnp.where(act[:, None, None], k, kc_l[idx, w])
-        v_eff = jnp.where(act[:, None, None], v, vc_l[idx, w])
-        kc_l = kc_l.at[idx, w].set(k_eff)
-        vc_l = vc_l.at[idx, w].set(v_eff)
-        # Grouped-query attention against the slot's cached history.
+        pp = jnp.where(act, bt[idx, w // page], 0)
+        off = jnp.where(act, w % page, 0)
+        kc_l = kc_l.at[pp, off].set(k)
+        vc_l = vc_l.at[pp, off].set(v)
+        # Gather each slot's pages -> its logical KV history.
+        kh = kc_l[bt].reshape(ns, CTX, KVH, hd)
+        vh = vc_l[bt].reshape(ns, CTX, KVH, hd)
+        # Grouped-query attention against the gathered history.
         qg = q.reshape(ns, KVH, H // KVH, hd).astype(jnp.float32)
         scores = jnp.einsum("nkgd,nskd->nkgs", qg,
-                            kc_l.astype(jnp.float32)) / (hd ** 0.5)
-        mask = jnp.arange(S)[None, :] <= w[:, None]          # [ns, S]
+                            kh.astype(jnp.float32)) / (hd ** 0.5)
+        mask = jnp.arange(CTX)[None, :] <= w[:, None]        # [ns, CTX]
         scores = jnp.where(mask[:, None, None, :], scores, -1e30)
         wts = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("nkgs,nskd->nkgd", wts,
-                          vc_l.astype(jnp.float32))
+                          vh.astype(jnp.float32))
         attn = attn.reshape(ns, H * hd).astype(dt)
         x = x + attn @ lp["wo"].astype(dt)
         h = rms_norm(x, lp["mlp_norm"], mcfg.norm_eps)
@@ -172,15 +204,15 @@ def _build_fns(mcfg, n_slots: int, chunk: int):
         x = x + (jax.nn.silu(gate) * up) @ lp["w_down"].astype(dt)
         return x, kc_l, vc_l
 
-    def _step(params, kc, vc, last, pos, active, cos, sin):
+    def _step(params, kc, vc, bt, last, pos, active, cos, sin):
         act = active & (pos < S)
         x = jnp.take(params["embed"], last, axis=0).astype(dt)
 
         def body(carry, layer):
             x = carry
             lp, kc_l, vc_l = layer
-            x, kc_l, vc_l = _decode_layer(x, lp, kc_l, vc_l, pos, act,
-                                          cos, sin)
+            x, kc_l, vc_l = _decode_layer(x, lp, kc_l, vc_l, bt, pos,
+                                          act, cos, sin)
             return x, (kc_l, vc_l)
 
         x, (kc, vc) = jax.lax.scan(body, x, (params["layers"], kc, vc))
@@ -191,14 +223,14 @@ def _build_fns(mcfg, n_slots: int, chunk: int):
         pos2 = jnp.where(act, pos + 1, pos)
         return kc, vc, nxt, pos2
 
-    def decode(params, kc, vc, last, pos, active):
+    def decode(params, kc, vc, bt, last, pos, active):
         cos, sin = rope_frequencies(hd, S, mcfg.rope_theta)
         out0 = jnp.zeros((ns, chunk), jnp.int32)
 
         def body(i, carry):
             kc, vc, last, pos, out = carry
-            kc, vc, nxt, pos = _step(params, kc, vc, last, pos, active,
-                                     cos, sin)
+            kc, vc, nxt, pos = _step(params, kc, vc, bt, last, pos,
+                                     active, cos, sin)
             out = out.at[:, i].set(nxt)
             return kc, vc, nxt, pos, out
 
@@ -206,11 +238,19 @@ def _build_fns(mcfg, n_slots: int, chunk: int):
             0, chunk, body, (kc, vc, last, pos, out0))
         return kc, vc, last, pos, out
 
+    def poke(last, pos, slot, first, length):
+        """Admission bookkeeping ON DEVICE: set one slot's (last, pos).
+        Keeps the decode chain free of device->host fetches — a host
+        read of last/pos at admission would cost a full tunnel RTT
+        before the TTFT token could be emitted."""
+        return last.at[slot].set(first), pos.at[slot].set(length)
+
     import jax as _jax
     prefill_jit = _jax.jit(prefill, donate_argnums=(1, 2))
-    decode_jit = _jax.jit(decode, donate_argnums=(1, 2))
+    decode_jit = _jax.jit(decode, donate_argnums=(1, 2, 4, 5))
     adopt_jit = _jax.jit(adopt, donate_argnums=(0, 1))
-    return prefill_jit, decode_jit, adopt_jit, empty_caches
+    poke_jit = _jax.jit(poke, donate_argnums=(0, 1))
+    return prefill_jit, decode_jit, adopt_jit, poke_jit, empty_caches
 
 
 class _Request:
@@ -233,14 +273,16 @@ class _Request:
 
 
 class Engine:
-    """One continuous-batching decode loop. submit() from any thread;
-    each request streams token chunks through its own queue."""
+    """One continuous-batching decode loop over a paged KV cache.
+    submit() from any thread; each request streams token chunks through
+    its own queue."""
 
     # Smallest prefill bucket; buckets double up to max_seq.
     _MIN_BUCKET = 32
 
     def __init__(self, params, mcfg, *, n_slots: int = 8,
-                 decode_chunk: int = 4):
+                 decode_chunk: int = 8, page_size: int = 64,
+                 n_pages: Optional[int] = None):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -251,8 +293,21 @@ class Engine:
         self.n_slots = n_slots
         self.chunk = decode_chunk
         self.params = params
-        self._prefill, self._decode, self._adopt, empty = _build_fns(
-            mcfg, n_slots, decode_chunk)
+        S = mcfg.max_seq
+        self.page = min(page_size, S)
+        self.maxp = -(-S // self.page)
+        if n_pages is None:
+            # Null page + half the worst case: density comes from short
+            # requests reserving only what len+max_tokens needs.
+            n_pages = 1 + max(self.maxp, (n_slots * self.maxp + 1) // 2)
+        if n_pages < 1 + self.maxp:
+            raise ValueError(
+                f"n_pages={n_pages} cannot hold one max_seq request "
+                f"({self.maxp} pages of {self.page} tokens) + null page")
+        self.n_pages = n_pages
+        (self._prefill, self._decode, self._adopt, self._poke,
+         empty) = _build_fns(mcfg, n_slots, decode_chunk, self.page,
+                             n_pages)
         self._empty = empty
         self._kc, self._vc = empty()
         # Prefill shape buckets (powers of 2, capped at max_seq): a
@@ -264,12 +319,19 @@ class Engine:
             self.buckets.append(b)
             b *= 2
         self.buckets.append(mcfg.max_seq)
-        # host-side slot state
+        # host-side slot + page state (control flow is host-predicted;
+        # only token VALUES come back from the device)
         self._slot_req: List[Optional[_Request]] = [None] * n_slots
+        self._slot_pages: List[List[int]] = [[] for _ in range(n_slots)]
+        self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        self._bt = np.zeros((n_slots, self.maxp), np.int32)
         self._pos = np.zeros(n_slots, np.int32)
         self._active = np.zeros(n_slots, bool)
-        self._last = np.zeros(n_slots, np.int32)
-        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._last_d = jnp.zeros(n_slots, jnp.int32)
+        self._pos_d = jnp.zeros(n_slots, jnp.int32)
+        self.peak_pages_used = 0
+        self._pending: deque = deque()
+        self._plock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
         self.error: Optional[str] = None
@@ -278,29 +340,50 @@ class Engine:
         # compiles); intermediate buckets warm in a BACKGROUND thread —
         # until one is ready, prompts round UP to the next warmed bucket,
         # so an unwarmed shape never compiles inside the engine loop
-        # (which would freeze every in-flight decode stream).
+        # (which would freeze every in-flight decode stream). Warm
+        # writes target the null page (pages = zeros), so they never
+        # touch real KV state.
         self._warm = {self.buckets[0], self.buckets[-1]}
+        null_pages = jnp.zeros(self.maxp, jnp.int32)
         for width in sorted(self._warm):
             toks = jnp.zeros((1, width), jnp.int32)
             self._kc, self._vc, first = self._prefill(
-                self.params, self._kc, self._vc, 0, toks, 1)
-            # PD adopt program for the same width (arena is all-zeros
-            # here, so the slot-0 write is a no-op).
+                self.params, self._kc, self._vc, null_pages, toks, 1)
             kv = jnp.zeros((mcfg.n_layers, width, mcfg.n_kv_heads,
                             mcfg.head_dim), mcfg.dtype)
-            self._kc, self._vc = self._adopt(self._kc, self._vc, 0, kv, kv)
-        self._kc, self._vc, last, pos, out = self._decode(
-            self.params, self._kc, self._vc,
-            jnp.zeros(n_slots, jnp.int32), jnp.zeros(n_slots, jnp.int32),
-            jnp.zeros(n_slots, bool))
+            self._kc, self._vc = self._adopt(self._kc, self._vc,
+                                             null_pages, kv, kv)
+        self._kc, self._vc, self._last_d, self._pos_d, out = self._decode(
+            self.params, self._kc, self._vc, jnp.asarray(self._bt),
+            self._last_d, self._pos_d, jnp.zeros(n_slots, bool))
+        # Warm both poke variants: host-int `first` (adopt path) and
+        # device-scalar `first` (prefill path).
+        self._last_d, self._pos_d = self._poke(self._last_d, self._pos_d,
+                                               0, 0, 0)
+        self._last_d, self._pos_d = self._poke(self._last_d, self._pos_d,
+                                               0, first, 0)
+        self._last_d, self._pos_d = self._poke(self._last_d, self._pos_d,
+                                               0, 0, 0)
         int(first)
+        # Emission FIFO: the dispatch loop enqueues device arrays; the
+        # emitter thread performs the host syncs. maxsize bounds how far
+        # dispatch can run ahead of the device (pipeline depth): 2 keeps
+        # chunks back-to-back while a newly-arrived request's prefill
+        # never queues behind more than 2 chunks.
+        self._emit_q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._emitter = threading.Thread(target=self._emit_loop,
+                                         daemon=True, name="llm-emit")
+        self._emitter.start()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="llm-engine")
         self._thread.start()
+        self._warm_thread: Optional[threading.Thread] = None
         middles = [b for b in self.buckets if b not in self._warm]
         if middles:
-            threading.Thread(target=self._warm_buckets, args=(middles,),
-                             daemon=True, name="llm-bucket-warm").start()
+            self._warm_thread = threading.Thread(
+                target=self._warm_buckets, args=(middles,), daemon=True,
+                name="llm-bucket-warm")
+            self._warm_thread.start()
 
     def _warm_buckets(self, widths: List[int]) -> None:
         """Warm intermediate prefill buckets off the engine loop; each
@@ -313,18 +396,19 @@ class Engine:
         try:
             kc, vc = self._empty()
             m = self.mcfg
+            null_pages = jnp.zeros(self.maxp, jnp.int32)
             for width in widths:
                 if self._stop:
                     return
                 toks = jnp.zeros((1, width), jnp.int32)
-                kc, vc, first = self._prefill(self.params, kc, vc, 0,
-                                              toks, 1)
+                kc, vc, first = self._prefill(self.params, kc, vc,
+                                              null_pages, toks, 1)
                 int(first)  # host sync: compile fully landed
                 # Warm the PD adopt program for this width too (a first
                 # cross-pool handoff must not compile in the loop).
                 kv = jnp.zeros((m.n_layers, width, m.n_kv_heads,
                                 m.head_dim), m.dtype)
-                kc, vc = self._adopt(kc, vc, 0, kv, kv)
+                kc, vc = self._adopt(kc, vc, null_pages, kv, kv)
                 self._warm.add(width)
         except Exception:
             return  # engine shutting down / compile failure: keep
@@ -340,7 +424,8 @@ class Engine:
         if max_tokens <= 0:
             req.out.put(None)  # nothing to generate; skip the prefill too
             return req.out
-        self._pending.put(req)
+        with self._plock:
+            self._pending.append(req)
         self._wake.set()
         return req.out
 
@@ -358,7 +443,8 @@ class Engine:
         if max_tokens <= 1:
             req.out.put(None)  # prefill's first token was the whole ask
             return req.out
-        self._pending.put(req)
+        with self._plock:
+            self._pending.append(req)
         self._wake.set()
         return req.out
 
@@ -366,24 +452,64 @@ class Engine:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
+        try:
+            self._emit_q.put(None, timeout=10)  # sentinel: drain + exit
+        except queue.Full:
+            pass
+        self._emitter.join(timeout=30)
+        # Join the background bucket warmer too: a daemon thread still
+        # inside an XLA compile at interpreter shutdown aborts the
+        # process (C++ exception with no Python frame to land in).
+        if self._warm_thread is not None:
+            self._warm_thread.join(timeout=60)
+
+    def pages_in_use(self) -> int:
+        return (self.n_pages - 1) - len(self._free)
 
     # ------------------------------------------------------------------
     def _admit(self) -> None:
+        """Admit pending requests into free slots while their page
+        reservations fit (FIFO: the head waits — for a finish to free a
+        slot or pages — rather than being overtaken). Safe to call with
+        chunks in flight: an in-flight chunk saw the new slot as
+        inactive and never touches its freshly-allocated pages; the
+        prefill + poke ops simply queue behind it on the device.
+        Prefills for a BURST of admissions are all dispatched (and their
+        first-token transfers started) before anything blocks, so N
+        admissions cost ~one round-trip, not N."""
         np, jnp = self._np, self._jnp
-        for slot in range(self.n_slots):
-            if self._active[slot]:
-                continue
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                return
+        S = self.mcfg.max_seq
+        emits: List[Tuple[_Request, Any, bool]] = []  # (req, first, done)
+        while True:
+            with self._plock:
+                req = self._pending[0] if self._pending else None
+            if req is None:
+                break
+            slot = next((i for i in range(self.n_slots)
+                         if not self._active[i]
+                         and self._slot_req[i] is None), None)
+            need = -(-min(len(req.ids) + req.max_tokens, S) // self.page)
+            if slot is None or len(self._free) < need:
+                break  # head-of-line waits for a finish
+
+            with self._plock:
+                self._pending.popleft()
+            pages = [self._free.pop() for _ in range(need)]
+            self._slot_pages[slot] = pages
+            self.peak_pages_used = max(self.peak_pages_used,
+                                       self.pages_in_use())
+            self._bt[slot, :] = 0
+            self._bt[slot, :need] = pages
+            pages_arr = np.zeros(self.maxp, np.int32)
+            pages_arr[:need] = pages
+            pages_arr = jnp.asarray(pages_arr)
             if req.adopt_kv is not None:
                 # Disaggregated handoff: write the external KV into the
-                # slot; `first` was already streamed by the prefill side.
-                # An UNWARMED handoff width is host-padded to the next
-                # warmed bucket (a zero tail is never attended — the
-                # attention mask stops at pos) instead of compiling a
-                # fresh adopt program inside the loop.
+                # slot's pages; `first` was already streamed by the
+                # prefill side. An UNWARMED handoff width is host-padded
+                # to the next warmed bucket (a zero tail is never
+                # attended — the mask stops at pos) instead of compiling
+                # a fresh adopt program inside the loop.
                 ks, vs = req.adopt_kv
                 req.adopt_kv = None
                 width = ks.shape[1]
@@ -397,7 +523,7 @@ class Engine:
                     pv[:, :width] = np.asarray(vs)
                     ks, vs = jnp.asarray(pk), jnp.asarray(pv)
                 self._kc, self._vc = self._adopt(
-                    self._kc, self._vc, slot, ks, vs)
+                    self._kc, self._vc, pages_arr, ks, vs)
                 first = req.first
             else:
                 # Only WARMED buckets are eligible (round up until the
@@ -408,25 +534,50 @@ class Engine:
                 toks = np.zeros((1, width), np.int32)
                 toks[0, :len(req.ids)] = req.ids
                 self._kc, self._vc, first = self._prefill(
-                    self.params, self._kc, self._vc, slot,
+                    self.params, self._kc, self._vc, pages_arr,
                     jnp.asarray(toks), len(req.ids))
-                first = int(first)
             req.slot = slot
             self._slot_req[slot] = req
             self._pos[slot] = len(req.ids)
-            self._last[slot] = first
             self._active[slot] = True
             req.produced = 1
-            if req.first < 0:
-                req.out.put([first])             # TTFT token, immediately
-            if (req.produced >= req.max_tokens
-                    or self._pos[slot] >= self.mcfg.max_seq):
-                self._finish(slot)
+            # Device-side slot bookkeeping (async — never a host
+            # round-trip; `first` stays a device scalar on the prefill
+            # path).
+            self._last_d, self._pos_d = self._poke(
+                self._last_d, self._pos_d, slot, first,
+                int(self._pos[slot]))
+            done = (req.produced >= req.max_tokens
+                    or self._pos[slot] >= S)
+            if done:
+                self._finish_state(slot)
+            emits.append((req, first, done))
+        # Start EVERY device->host copy first (async), THEN enqueue: a
+        # burst overlaps all its transfers even when the bounded
+        # _emit_q.put blocks partway through the enqueue loop.
+        for _, first, _ in emits:
+            try:
+                first.copy_to_host_async()
+            except AttributeError:
+                pass  # host int (adopt path)
+        for req, first, done in emits:
+            # The emitter thread performs the int(first) sync — the
+            # dispatch loop never blocks on the device.
+            self._emit_q.put(("first", req, first, done))
+
+    def _finish_state(self, slot: int) -> None:
+        """Free the slot + pages (host control state only — the stream's
+        terminating None is emitted by the emitter thread, AFTER the
+        slot's final tokens)."""
+        self._slot_req[slot] = None
+        self._active[slot] = False
+        self._free.extend(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._bt[slot, :] = 0
 
     def _finish(self, slot: int) -> None:
         req = self._slot_req[slot]
-        self._slot_req[slot] = None
-        self._active[slot] = False
+        self._finish_state(slot)
         if req is not None:
             req.out.put(None)
 
@@ -440,43 +591,103 @@ class Engine:
             for slot in range(self.n_slots):
                 self._finish(slot)
             while True:
-                try:
-                    self._pending.get_nowait().out.put(None)
-                except queue.Empty:
+                with self._plock:
+                    req = self._pending.popleft() if self._pending else None
+                if req is None:
                     break
+                req.out.put(None)
+
+    def _emit_loop(self) -> None:
+        """The only place host<->device syncs happen on the serving
+        path: fetch first tokens / chunk outputs and emit them to each
+        request's stream, in dispatch order (per-request FIFO is
+        preserved because the dispatch loop enqueues a request's "first"
+        before any of its chunks)."""
+        np = self._np
+        while True:
+            item = self._emit_q.get()
+            if item is None:
+                return
+            try:
+                if item[0] == "first":
+                    _, req, first, done = item
+                    if req.first < 0:
+                        req.out.put([int(first)])
+                    if done:
+                        req.out.put(None)
+                else:  # ("chunk", out_d, plan)
+                    _, out_d, plan = item
+                    out_h = np.asarray(out_d)
+                    for slot, req, take, fin in plan:
+                        toks = [int(t) for t in out_h[slot, :take]]
+                        if toks:
+                            req.out.put(toks)
+                        if fin:
+                            req.out.put(None)
+            except BaseException:
+                import traceback
+                self.error = self.error or traceback.format_exc()
+                # Terminate the affected streams rather than stranding
+                # their consumers.
+                if item[0] == "first":
+                    item[1].out.put(None)
+                else:
+                    for _, req, _, _ in item[2]:
+                        req.out.put(None)
 
     def _run_inner(self) -> None:
         np, jnp = self._np, self._jnp
+        S = self.mcfg.max_seq
         while not self._stop:
+            # Admission is pipeline-safe: an in-flight chunk saw the new
+            # slot as inactive, and its prefill/poke queue behind that
+            # chunk on the device.
             self._admit()
             if not self._active.any():
                 self._wake.wait(timeout=0.5)
                 self._wake.clear()
                 continue
-            pos_before = self._pos.copy()
-            self._kc, self._vc, last, pos, out = self._decode(
-                self.params, self._kc, self._vc,
-                jnp.asarray(self._last), jnp.asarray(self._pos),
-                jnp.asarray(self._active))
-            out_h = np.asarray(out)
-            # np.array copies: jax array views are read-only and the host
-            # mirrors are mutated on admit.
-            self._last = np.array(last)
-            self._pos = np.array(pos)
+            # Predict this chunk's control outcome on the host: per-slot
+            # emit counts and finishes depend only on pos/produced, never
+            # on token values — so the chunk's finishes free slots/pages
+            # IMMEDIATELY (the freed pages are safe to reuse: a later
+            # request always writes a position before reading it, and
+            # its device ops queue behind this chunk).
+            plan = []
             for slot in range(self.n_slots):
                 req = self._slot_req[slot]
                 if req is None or not self._active[slot]:
                     continue
-                # A slot frozen mid-chunk (pos hit max_seq) repeats its
-                # last token in `out` — only the genuinely-decoded steps
-                # are real output.
-                valid = max(0, min(self.chunk,
-                                   self.mcfg.max_seq - pos_before[slot]))
-                take = min(valid, req.max_tokens - req.produced)
-                toks = [int(t) for t in out_h[slot, :take]]
-                if toks:
-                    req.produced += len(toks)
-                    req.out.put(toks)
-                if (req.produced >= req.max_tokens
-                        or self._pos[slot] >= self.mcfg.max_seq):
-                    self._finish(slot)
+                valid = int(max(0, min(self.chunk, S - self._pos[slot])))
+                take = int(min(valid, req.max_tokens - req.produced))
+                fin = (req.produced + take >= req.max_tokens
+                       or self._pos[slot] + valid >= S)
+                req.produced += take
+                plan.append((slot, req, take, fin))
+            if not plan:  # defensive: never hot-spin
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            # COPIES, not views: jnp.asarray may alias numpy memory
+            # (zero-copy on the CPU backend), and this loop mutates
+            # _bt/_active in place while the dispatched chunk is still
+            # queued — an aliased buffer would let those mutations reach
+            # into the in-flight computation.
+            self._kc, self._vc, self._last_d, self._pos_d, out_d = \
+                self._decode(self.params, self._kc, self._vc,
+                             jnp.asarray(self._bt.copy()), self._last_d,
+                             self._pos_d,
+                             jnp.asarray(self._active.copy()))
+            self._pos = np.where(
+                self._active, np.minimum(self._pos + self.chunk, S),
+                self._pos).astype(np.int32)
+            for slot, req, take, fin in plan:
+                if fin and self._slot_req[slot] is req:
+                    self._finish_state(slot)
+            try:
+                out_d.copy_to_host_async()
+            except AttributeError:
+                pass
+            # Blocks when the emitter is `maxsize` chunks behind — the
+            # pipeline-depth bound.
+            self._emit_q.put(("chunk", out_d, plan))

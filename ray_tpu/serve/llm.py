@@ -36,8 +36,10 @@ class LLMConfig:
     max_seq: int = 512
     num_replicas: int = 1
     num_tpus: float = 1
-    max_ongoing_requests: int = 8
-    decode_chunk: int = 4          # tokens per device call
+    max_ongoing_requests: int = 16
+    decode_chunk: int = 8          # tokens per device call
+    page_size: int = 64            # KV page width (tokens)
+    kv_pages: Optional[int] = None  # physical pages (None: engine default)
     params_path: str = ""          # ray_tpu.train checkpoint dir (optional)
     tokenizer: Optional[Callable[[str], List[int]]] = None
     detokenizer: Optional[Callable[[List[int]], str]] = None
@@ -60,7 +62,9 @@ class LLMServer:
         self.mcfg, params = _model_from_cfg(cfg)
         self.engine = Engine(params, self.mcfg,
                              n_slots=cfg.max_ongoing_requests,
-                             decode_chunk=cfg.decode_chunk)
+                             decode_chunk=cfg.decode_chunk,
+                             page_size=cfg.page_size,
+                             n_pages=cfg.kv_pages)
 
     def _encode(self, prompt) -> List[int]:
         return _encode_prompt(self.cfg, prompt)
@@ -245,7 +249,9 @@ class DecodeServer:
         self.mcfg, params = _model_from_cfg(cfg)
         self.engine = Engine(params, self.mcfg,
                              n_slots=cfg.max_ongoing_requests,
-                             decode_chunk=cfg.decode_chunk)
+                             decode_chunk=cfg.decode_chunk,
+                             page_size=cfg.page_size,
+                             n_pages=cfg.kv_pages)
 
     def decode_stream(self, meta: Dict[str, Any]):
         """Pull the prefilled KV (device plane; slice-aware) and stream

@@ -205,8 +205,14 @@ def test_autoscaler_drops_failed_launches():
     scaler._idle_timeout, scaler._period = 30.0, 1.0
     scaler._launched, scaler._idle_since = [], {}
 
+    scaler._failure_backoff_s, scaler._next_launch_at = 0.0, 0.0
+
     assert scaler.update() == "up"        # launch 1 (will fail)
     assert len(scaler._launched) == 1
-    assert scaler.update() == "up"        # prunes failed, retries
+    assert scaler.update() is None        # prunes failed, enters backoff
+    assert scaler._failure_backoff_s > 0
+    assert not scaler._launched
+    scaler._next_launch_at = 0.0          # backoff elapsed
+    assert scaler.update() == "up"        # retries
     assert provider.created == 2
     assert [h["name"] for h in scaler._launched] == ["n2"]
