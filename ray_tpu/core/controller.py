@@ -130,11 +130,24 @@ class Controller:
         # the autoscaler).
         self._infeasible: Dict[tuple, tuple] = {}
         # Persistence (reference: gcs/store_client/redis_store_client.cc +
-        # gcs_init_data.cc rebuild-on-restart). A snapshot file holds the
-        # durable tables: KV (function table!), actors, named actors, PGs,
-        # jobs. Node entries are NOT persisted — agents re-register via
-        # the heartbeat "unknown" signal.
+        # gcs_init_data.cc rebuild-on-restart). A pluggable StoreClient
+        # holds the durable tables: KV (function table!), actors, named
+        # actors, PGs, jobs. Node entries are NOT persisted — agents
+        # re-register via the heartbeat "unknown" signal. With the
+        # sqlite backend on shared storage, a REPLACEMENT controller on
+        # another node restores the whole cluster (head failover).
+        from ray_tpu.core.store_client import (MemoryStoreClient,
+                                               store_client_for)
         self._storage_path = GlobalConfig.gcs_storage_path
+        try:
+            self._store = store_client_for(self._storage_path)
+        except Exception as e:
+            # A corrupt/locked store must not crash-loop the head: start
+            # fresh (the pre-seam behavior for unreadable snapshots).
+            logger.warning("could not open controller store %r: %r — "
+                           "starting with empty state",
+                           self._storage_path, e)
+            self._store = MemoryStoreClient()
         self._dirty = False
         if self._storage_path:
             self._restore_state()
@@ -146,15 +159,12 @@ class Controller:
         self._dirty = True
 
     def _restore_state(self) -> None:
-        import os
-        import pickle
-        if not os.path.exists(self._storage_path):
-            return
         try:
-            with open(self._storage_path, "rb") as f:
-                snap = pickle.load(f)
+            snap = self._store.load()
         except Exception as e:
             logger.warning("could not restore controller state: %r", e)
+            return
+        if snap is None:
             return
         self.kv = snap.get("kv", {})
         self.named_actors = snap.get("named_actors", {})
@@ -186,8 +196,6 @@ class Controller:
                     len(self.kv))
 
     def _snapshot_state(self) -> None:
-        import os
-        import pickle
         snap = {
             "kv": {ns: space for ns, space in self.kv.items()
                    if ns != "pkg"},  # pkg blobs live as side files
@@ -211,10 +219,7 @@ class Controller:
                 "bundle_label_selector": p.bundle_label_selector,
             } for p in self.pgs.values()],
         }
-        tmp = self._storage_path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(snap, f)
-        os.replace(tmp, self._storage_path)  # atomic swap
+        self._store.save(snap)
 
     async def _resume_restored(self) -> None:
         """After a restart: re-drive restored PENDING work and fail over
@@ -936,6 +941,12 @@ class Controller:
     async def shutdown_controller(self) -> None:
         """Terminate the controller process (cli stop's final step)."""
         import sys
+        try:
+            if self._dirty:
+                self._snapshot_state()
+            self._store.close()
+        except Exception:
+            pass
         asyncio.get_running_loop().call_later(0.2, sys.exit, 0)
 
     # ------------------------------------------------------------------

@@ -310,6 +310,27 @@ class CoreWorker:
                 self.controller, "log_events", _print_log,
                 from_latest=True).start()
 
+    async def retarget_controller(self, addr) -> bool:
+        """Follow a controller head failover: swap the controller client
+        to the replacement's address (the durable-store restart path).
+        Worker->worker data paths are unaffected; controller-fed
+        subscriptions (driver logs, actor state events) repoint to the
+        new head and resync via the pubsub epoch-restart detection.
+        Exposed over RPC so the node agent can propagate a failover to
+        its hosted workers."""
+        addr = (addr[0], int(addr[1]))
+        old = self.controller
+        self.controller_addr = addr
+        self.controller = RpcClient(addr)
+        try:
+            await old.close()
+        except Exception:
+            pass
+        for sub in (getattr(self, "_log_sub", None), self._actor_sub):
+            if sub is not None:
+                sub.retarget(self.controller)
+        return True
+
     @property
     def address(self) -> Address:
         return ("127.0.0.1", self.port)

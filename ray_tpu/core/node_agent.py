@@ -241,6 +241,52 @@ class NodeAgent:
                     self.resources_total)
         return self.port
 
+    def _surviving_actors(self) -> list:
+        """Dedicated actors whose worker processes are still alive —
+        reported on (re-)registration so the controller can fail over
+        only the ones that actually died."""
+        return [w.dedicated_actor for w in self.workers.values()
+                if w.dedicated_actor is not None
+                and w.proc.poll() is None]
+
+    async def _reregister(self) -> None:
+        """register_node with the SAME node id (controller restarted or
+        replaced) so running workers/actors stay addressable."""
+        await self.controller.call(
+            "register_node", self.node_id.binary(),
+            (self.host, self.port), self.resources_total, self.labels,
+            hosted_actors=self._surviving_actors())
+
+    async def retarget_controller(self, addr) -> bool:
+        """Point this agent at a REPLACEMENT controller (head failover:
+        a new controller restored the cluster state from the durable
+        store on another node). Re-registers with the same node id,
+        repoints the membership subscription, and propagates the new
+        address to every live hosted worker, whose core workers hold
+        their own controller clients (reference: raylet reconnecting to
+        the restarted GCS, gcs_rpc_client address refresh)."""
+        addr = (addr[0], int(addr[1]))
+        logger.info("retargeting controller %s -> %s",
+                    self.controller_addr, addr)
+        old = self.controller
+        self.controller_addr = addr
+        self.controller = RpcClient(addr)
+        try:
+            await old.close()
+        except Exception:
+            pass
+        await self._reregister()
+        self._node_sub.retarget(self.controller)
+        for w in list(self.workers.values()):
+            if w.proc.poll() is not None or w.client is None:
+                continue
+            try:
+                await w.client.call("retarget_controller", addr)
+            except Exception as e:
+                logger.warning("worker %s retarget failed: %r",
+                               w.worker_id.hex()[:8], e)
+        return True
+
     async def _heartbeat_loop(self) -> None:
         period = GlobalConfig.resource_broadcast_period_ms / 1000
         while not self._shutdown:
@@ -255,14 +301,7 @@ class NodeAgent:
                     # actors we still host so the controller can fail
                     # over the ones that died during the outage.
                     logger.info("controller restarted; re-registering")
-                    hosted = [w.dedicated_actor
-                              for w in self.workers.values()
-                              if w.dedicated_actor is not None
-                              and w.proc.poll() is None]
-                    await self.controller.call(
-                        "register_node", self.node_id.binary(),
-                        (self.host, self.port), self.resources_total,
-                        self.labels, hosted_actors=hosted)
+                    await self._reregister()
                 elif not alive:
                     logger.warning("controller declared this node dead")
             except Exception as e:

@@ -133,6 +133,12 @@ class Subscription:
             self._task.cancel()
             self._task = None
 
+    def retarget(self, client) -> None:
+        """Point the poll loop at a replacement hub (controller head
+        failover): the next poll round uses the new client; the
+        epoch-restart detection then resyncs the sequence cursor."""
+        self._client = client
+
     async def _run(self) -> None:
         while True:
             try:
