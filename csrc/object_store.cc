@@ -292,6 +292,12 @@ int store_pin(void* handle, const char* id, int pinned) {
   return 0;
 }
 
+// Borrowed pointer to the store's directory string (valid for the
+// store's lifetime) — used by the fast-path sidecar (store_server.cc).
+const char* store_dir_ref(void* handle) {
+  return static_cast<Store*>(handle)->dir.c_str();
+}
+
 uint64_t store_used(void* handle) {
   auto* s = static_cast<Store*>(handle);
   std::lock_guard<std::mutex> g(s->mu);

@@ -35,6 +35,16 @@ int store_release(void* handle, const char* id);
 int store_delete(void* handle, const char* id);
 int store_contains(void* handle, const char* id);
 int store_pin(void* handle, const char* id, int pinned);
+void* store_server_start(void* store_handle, const char* sock_path,
+                         int* notify_fd_out);
+int store_server_drain(void* handle, char* buf, int cap);
+void store_server_stop(void* handle);
+int store_client_connect(const char* sock_path);
+int store_client_request(int fd, uint8_t op, const char* oid, uint64_t a,
+                         uint64_t b, const char* name, int32_t* rc_out,
+                         uint64_t* ds_out, uint64_t* ms_out,
+                         char* path_out, int path_cap);
+void store_client_close(int fd);
 uint64_t store_used(void* handle);
 uint64_t store_capacity(void* handle);
 uint64_t store_num_objects(void* handle);
@@ -262,6 +272,63 @@ void TestConcurrentCreateRelease() {
 
 }  // namespace
 
+
+void TestSidecarProtocol() {
+  // Fast-path sidecar: ingest/get/release/delete over the unix socket,
+  // with journal events draining to the (Python-side) agent.
+  std::string dir = TempDir("sidecar");
+  void* s = store_create(dir.c_str(), 1 << 16);
+  std::string sock = dir + ".sock";
+  int notify_fd = -1;
+  void* srv = store_server_start(s, sock.c_str(), &notify_fd);
+  assert(srv != nullptr && notify_fd >= 0);
+  int fd = store_client_connect(sock.c_str());
+  assert(fd >= 0);
+
+  std::string src = dir + "/ingest-c-1";
+  WriteFile(src, "sidecar-payload!");
+  std::string id = MakeId('s');
+  int32_t rc; uint64_t ds, ms; char path[4096];
+  // INGEST
+  assert(store_client_request(fd, 1, id.c_str(), 16, 0, "ingest-c-1",
+                              &rc, &ds, &ms, path, sizeof path) == 0);
+  assert(rc == 0);
+  // Path traversal refused.
+  assert(store_client_request(fd, 1, id.c_str(), 1, 0, "../evil",
+                              &rc, &ds, &ms, path, sizeof path) == 0);
+  assert(rc == -4);
+  // GET pins and returns the mapped path.
+  assert(store_client_request(fd, 2, id.c_str(), 0, 0, nullptr,
+                              &rc, &ds, &ms, path, sizeof path) == 0);
+  assert(rc == 0 && ds == 16 && FileExists(path));
+  // RELEASE + DELETE
+  assert(store_client_request(fd, 3, id.c_str(), 0, 0, nullptr,
+                              &rc, &ds, &ms, path, sizeof path) == 0);
+  assert(rc == 0);
+  assert(store_client_request(fd, 4, id.c_str(), 0, 0, nullptr,
+                              &rc, &ds, &ms, path, sizeof path) == 0);
+  assert(rc == 0);
+  // CONTAINS -> absent now.
+  assert(store_client_request(fd, 5, id.c_str(), 0, 0, nullptr,
+                              &rc, &ds, &ms, path, sizeof path) == 0);
+  assert(rc == 0);
+  // Journal carries the ingest (op 1, size 16) then the delete (op 4).
+  char pokebyte;
+  assert(::read(notify_fd, &pokebyte, 1) >= 0 || true);
+  char buf[29 * 8];
+  int n = store_server_drain(srv, buf, sizeof buf);
+  assert(n == 29 * 2);
+  assert(buf[0] == 1 && std::memcmp(buf + 1, id.data(), 20) == 0);
+  uint64_t jsize;
+  std::memcpy(&jsize, buf + 21, 8);
+  assert(jsize == 16);
+  assert(buf[29] == 4);
+  store_client_close(fd);
+  store_server_stop(srv);
+  store_destroy(s);
+  std::printf("  sidecar OK\n");
+}
+
 int main() {
   TestCreateSealGetLifecycle();
   TestEvictionRespectsPinsAndRefs();
@@ -269,6 +336,7 @@ int main() {
   TestIngestPinnedSurvivesPressure();
   TestConcurrentIngestEvict();
   TestConcurrentCreateRelease();
+  TestSidecarProtocol();
   std::printf("object_store_test: ALL OK\n");
   return 0;
 }

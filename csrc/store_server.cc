@@ -1,0 +1,372 @@
+// Native fast-path server + client for the shared-memory object store.
+//
+// TPU-native analogue of the reference's plasma store socket protocol
+// (reference: src/ray/object_manager/plasma/{store_runner.cc,client.cc} —
+// there the store IS a socket server speaking flatbuffers; here the
+// Python agent's asyncio RPC remains the control plane while THIS
+// sidecar carries the hot object ops). The agent starts one server
+// thread inside its process sharing the native Store handle; workers
+// connect a blocking unix-socket client and perform put(ingest)/get/
+// release/delete/contains with ZERO Python or event-loop work on either
+// side — the whole round-trip is two small socket writes between two C
+// threads.
+//
+// The Python agent still owns object lifecycle bookkeeping (primary
+// ledger, seal waiters, spill policy). A lock-protected EVENT JOURNAL
+// records every ingest/delete the sidecar admits; a pipe byte wakes the
+// agent's event loop, which drains the journal via store_server_drain()
+// and applies the bookkeeping. Full-store ingests are REFUSED (rc -2):
+// the worker falls back to the RPC path whose admission can spill.
+//
+// Wire format (little-endian, fixed header):
+//   request : u8 op | 20B oid | u64 a | u64 b | u16 nlen | name[nlen]
+//   response: i32 rc | u64 ds | u64 ms | u16 plen | path[plen]
+// Ops: 1 INGEST(a=data_size, b=meta_size, name=ingest file)
+//      2 GET (pins; pair with RELEASE)   3 RELEASE
+//      4 DELETE                          5 CONTAINS (rc = 0/1/2)
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+extern "C" {
+// From object_store.cc (same shared library).
+int store_ingest_object(void* handle, const char* id, const char* src_path,
+                        uint64_t data_size, uint64_t meta_size, int pinned);
+int store_get(void* handle, const char* id, char* out_path, int path_cap,
+              uint64_t* data_size, uint64_t* meta_size);
+int store_release(void* handle, const char* id);
+int store_delete(void* handle, const char* id);
+int store_contains(void* handle, const char* id);
+const char* store_dir_ref(void* handle);
+}
+
+namespace {
+
+constexpr int kIdSize = 20;
+constexpr uint8_t kOpIngest = 1, kOpGet = 2, kOpRelease = 3,
+                  kOpDelete = 4, kOpContains = 5;
+
+struct Event {       // journal entry: 29 bytes packed on drain
+  uint8_t op;        // kOpIngest | kOpDelete
+  char oid[kIdSize];
+  uint64_t size;
+};
+
+struct Server {
+  void* store = nullptr;
+  std::string dir;
+  int listen_fd = -1;
+  int notify_r = -1, notify_w = -1;  // pipe: journal nonempty signal
+  pthread_t accept_thread;
+  std::mutex mu;
+  std::vector<Event> journal;
+  std::vector<int> conn_fds;             // live connections (under mu)
+  std::atomic<int> active_conns{0};      // ConnLoop threads running
+  std::atomic<bool> stopping{false};
+};
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+void Journal(Server* s, uint8_t op, const char* oid, uint64_t size) {
+  bool was_empty;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    was_empty = s->journal.empty();
+    Event e;
+    e.op = op;
+    std::memcpy(e.oid, oid, kIdSize);
+    e.size = size;
+    s->journal.push_back(e);
+  }
+  if (was_empty) {
+    char b = 1;
+    (void)!::write(s->notify_w, &b, 1);
+  }
+}
+
+struct ConnArgs {
+  Server* server;
+  int fd;
+};
+
+void* ConnLoop(void* argp) {
+  ConnArgs* args = static_cast<ConnArgs*>(argp);
+  Server* s = args->server;
+  int fd = args->fd;
+  delete args;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->conn_fds.push_back(fd);
+  }
+  char oid[kIdSize];
+  char name[512];
+  char path[4096];
+  for (;;) {
+    uint8_t op;
+    uint64_t a, b;
+    uint16_t nlen;
+    if (!ReadFull(fd, &op, 1) || !ReadFull(fd, oid, kIdSize) ||
+        !ReadFull(fd, &a, 8) || !ReadFull(fd, &b, 8) ||
+        !ReadFull(fd, &nlen, 2)) {
+      break;
+    }
+    if (nlen >= sizeof(name)) break;
+    if (nlen && !ReadFull(fd, name, nlen)) break;
+    name[nlen] = 0;
+
+    int32_t rc = -1;
+    uint64_t ds = 0, ms = 0;
+    uint16_t plen = 0;
+    path[0] = 0;
+    switch (op) {
+      case kOpIngest: {
+        // Same validation as the agent RPC: relative ingest-file names
+        // only — a worker must not rename arbitrary paths in.
+        if (std::strncmp(name, "ingest-", 7) != 0 ||
+            std::strchr(name, '/') != nullptr) {
+          rc = -4;
+          break;
+        }
+        std::string src = s->dir + "/" + name;
+        rc = store_ingest_object(s->store, oid, src.c_str(), a, b,
+                                 /*pinned=*/1);
+        if (rc == 0) Journal(s, kOpIngest, oid, a + b);
+        break;
+      }
+      case kOpGet:
+        rc = store_get(s->store, oid, path, sizeof(path), &ds, &ms);
+        if (rc == 0) plen = (uint16_t)std::strlen(path);
+        break;
+      case kOpRelease:
+        rc = store_release(s->store, oid);
+        break;
+      case kOpDelete:
+        rc = store_delete(s->store, oid);
+        // Journal even when the store never had it (-1): the Python
+        // agent may hold spill state for the oid that must drop too.
+        Journal(s, kOpDelete, oid, 0);
+        break;
+      case kOpContains:
+        rc = store_contains(s->store, oid);
+        break;
+      default:
+        rc = -5;
+    }
+    if (!WriteFull(fd, &rc, 4) || !WriteFull(fd, &ds, 8) ||
+        !WriteFull(fd, &ms, 8) || !WriteFull(fd, &plen, 2) ||
+        (plen && !WriteFull(fd, path, plen))) {
+      break;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    for (size_t i = 0; i < s->conn_fds.size(); i++) {
+      if (s->conn_fds[i] == fd) {
+        s->conn_fds.erase(s->conn_fds.begin() + i);
+        break;
+      }
+    }
+  }
+  ::close(fd);
+  s->active_conns.fetch_sub(1);
+  return nullptr;
+}
+
+void* AcceptLoop(void* argp) {
+  Server* s = static_cast<Server*>(argp);
+  for (;;) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stopping) return nullptr;
+      continue;
+    }
+    if (s->stopping.load()) {
+      ::close(fd);
+      return nullptr;
+    }
+    auto* args = new ConnArgs{s, fd};
+    s->active_conns.fetch_add(1);
+    pthread_t t;
+    if (pthread_create(&t, nullptr, ConnLoop, args) == 0) {
+      pthread_detach(t);
+    } else {
+      s->active_conns.fetch_sub(1);
+      ::close(fd);
+      delete args;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts the sidecar inside the agent process. Returns the server
+// handle (NULL on failure); *notify_fd_out receives the read end of the
+// journal-notification pipe (register with the event loop).
+void* store_server_start(void* store_handle, const char* sock_path,
+                         int* notify_fd_out) {
+  auto* s = new Server();
+  s->store = store_handle;
+  s->dir = store_dir_ref(store_handle);
+  int fds[2];
+  if (::pipe(fds) != 0) {
+    delete s;
+    return nullptr;
+  }
+  s->notify_r = fds[0];
+  s->notify_w = fds[1];
+  ::fcntl(s->notify_r, F_SETFL, O_NONBLOCK);
+  s->listen_fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  sockaddr_un addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  std::snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", sock_path);
+  ::unlink(sock_path);
+  if (::bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    ::close(fds[0]);
+    ::close(fds[1]);
+    delete s;
+    return nullptr;
+  }
+  if (pthread_create(&s->accept_thread, nullptr, AcceptLoop, s) != 0) {
+    ::close(s->listen_fd);
+    ::close(fds[0]);
+    ::close(fds[1]);
+    delete s;
+    return nullptr;
+  }
+  *notify_fd_out = s->notify_r;
+  return s;
+}
+
+// Drain journal events into buf as 29-byte records (u8 op | 20B oid |
+// u64 size). Returns bytes written. Also consumes the pipe signal.
+int store_server_drain(void* handle, char* buf, int cap) {
+  auto* s = static_cast<Server*>(handle);
+  char scratch[64];
+  while (::read(s->notify_r, scratch, sizeof(scratch)) > 0) {
+  }  // notify_r is O_NONBLOCK: drains the wake bytes without blocking
+  std::lock_guard<std::mutex> g(s->mu);
+  int n = 0;
+  size_t taken = 0;
+  for (const Event& e : s->journal) {
+    if (n + 29 > cap) break;
+    buf[n] = (char)e.op;
+    std::memcpy(buf + n + 1, e.oid, kIdSize);
+    std::memcpy(buf + n + 21, &e.size, 8);
+    n += 29;
+    taken++;
+  }
+  s->journal.erase(s->journal.begin(), s->journal.begin() + taken);
+  return n;
+}
+
+void store_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  s->stopping.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  pthread_join(s->accept_thread, nullptr);
+  // Kick every live connection out of its blocking read, then wait for
+  // the detached handler threads to finish — freeing the Server while a
+  // ConnLoop still references it would be a use-after-free.
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (int spins = 0; s->active_conns.load() > 0 && spins < 5000; spins++) {
+    ::usleep(1000);
+  }
+  ::close(s->notify_r);
+  ::close(s->notify_w);
+  if (s->active_conns.load() == 0) {
+    delete s;  // else: leak one Server rather than risk a UAF
+  }
+}
+
+// ---------------------------------------------------------------------
+// Blocking client (runs in worker processes; no event loop).
+// ---------------------------------------------------------------------
+
+int store_client_connect(const char* sock_path) {
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  std::snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", sock_path);
+  if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+// Returns 0 on transport success (rc/ds/ms/path filled), -1 on IO error
+// (caller should reconnect or fall back to the RPC path).
+int store_client_request(int fd, uint8_t op, const char* oid, uint64_t a,
+                         uint64_t b, const char* name, int32_t* rc_out,
+                         uint64_t* ds_out, uint64_t* ms_out,
+                         char* path_out, int path_cap) {
+  uint16_t nlen = name ? (uint16_t)std::strlen(name) : 0;
+  char req[1 + kIdSize + 8 + 8 + 2];
+  req[0] = (char)op;
+  std::memcpy(req + 1, oid, kIdSize);
+  std::memcpy(req + 21, &a, 8);
+  std::memcpy(req + 29, &b, 8);
+  std::memcpy(req + 37, &nlen, 2);
+  if (!WriteFull(fd, req, sizeof(req))) return -1;
+  if (nlen && !WriteFull(fd, name, nlen)) return -1;
+  int32_t rc;
+  uint64_t ds, ms;
+  uint16_t plen;
+  if (!ReadFull(fd, &rc, 4) || !ReadFull(fd, &ds, 8) ||
+      !ReadFull(fd, &ms, 8) || !ReadFull(fd, &plen, 2)) {
+    return -1;
+  }
+  if (plen >= path_cap) return -1;
+  if (plen && !ReadFull(fd, path_out, plen)) return -1;
+  path_out[plen] = 0;
+  *rc_out = rc;
+  *ds_out = ds;
+  *ms_out = ms;
+  return 0;
+}
+
+void store_client_close(int fd) { ::close(fd); }
+
+}  // extern "C"

@@ -212,6 +212,12 @@ class CoreWorker:
         # Direct-write put path: the local store dir (fetched once) and a
         # per-process ingest-file counter.
         self._store_dir_cache: Optional[str] = None
+        # Native fast path to the agent's store sidecar (C unix socket,
+        # blocking, no event loop — csrc/store_server.cc). Probed
+        # lazily alongside the store dir; None = unavailable.
+        self._fastpath = None
+        self._fastpath_probed = False
+        self._map_cache_lock = threading.Lock()
         self._ingest_seq = 0
         # Per-peer batched store frees (flushed on the next loop tick).
         self._free_buf: Dict[tuple, list] = {}
@@ -524,7 +530,20 @@ class CoreWorker:
     def _flush_frees(self) -> None:
         self._free_flush_scheduled = False
         buf, self._free_buf = self._free_buf, {}
+        local = tuple(self.agent_addr) if self.agent_addr else None
         for addr, oids in buf.items():
+            # Local frees ride the C sidecar (microseconds, no agent
+            # event-loop work; the journal keeps the agent's ledger
+            # authoritative). Remote frees stay RPC.
+            if addr == local:
+                fp = self._fastpath if self._fastpath_probed else None
+                if fp is not None:
+                    try:
+                        for oid in oids:
+                            fp.delete(oid)
+                        continue
+                    except OSError:
+                        pass  # connection lost: fall through to RPC
             try:
                 peer = self._client_for_worker(addr)
                 spawn(self._call_ignore_errors(peer, "free_objects", oids))
@@ -959,8 +978,82 @@ class CoreWorker:
         sv = serialization.serialize(value)
         ref = ObjectRef(oid, self.address)
         self.add_local_ref(ref)
+        # Fast path: a FRESH oid with no contained refs needs no loop
+        # coordination (nobody can be waiting on it yet — the same
+        # argument as put_inline_marker), so serialize + write + one C
+        # sidecar round-trip happens synchronously on this thread.
+        if not sv.contained_refs and self._try_fast_put(oid.binary(), sv):
+            return ref
         self._run(self._do_put(oid.binary(), sv)).result()
         return ref
+
+    def _try_fast_put(self, oid: bytes, sv) -> bool:
+        meta = sv.meta()
+        total = sv.total_size + len(meta)
+        if sv.total_size <= GlobalConfig.max_direct_call_object_size:
+            self.put_inline_marker(oid, sv)
+            return True
+        fp = self._get_fastpath()
+        # Big payloads keep the executor-offloaded loop path (the write
+        # would block this thread for tens of ms anyway).
+        if fp is None or total > 4 * 1024 * 1024:
+            return False
+        sdir = self._store_dir_cache
+        self._ingest_seq += 1
+        name = f"ingest-{os.getpid()}-{self._ingest_seq}"
+        path = os.path.join(sdir, name)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                sv.write_to_fd(fd)
+                os.pwrite(fd, meta, sv.total_size)
+            finally:
+                os.close(fd)
+            rc = fp.ingest(oid, name, sv.total_size, len(meta))
+        except OSError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        if rc != 0:
+            # Full (-2) or raced: clean up; the RPC path can spill.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        e = self._entry(oid, create=True)
+        e.creating_task = None
+        e.contained = []
+        self._mark_ready_stored(oid, self.node_id, self.agent_addr,
+                                sv.total_size)
+        return True
+
+    def _get_fastpath(self):
+        """Connect the C sidecar client once (probing store_info on the
+        loop if the dir cache is cold)."""
+        if self._fastpath_probed:
+            return self._fastpath
+        if self._store_dir_cache is None:
+            try:
+                info = self._run(self.agent.call("store_info")).result(10)
+                self._store_dir_cache = (info["dir"]
+                                         if os.path.isdir(info["dir"])
+                                         else "")
+                self._fp_sock = info.get("fastpath_sock", "")
+            except Exception:
+                return None
+        self._fastpath_probed = True
+        sock = getattr(self, "_fp_sock", "")
+        if self._store_dir_cache and sock and os.path.exists(sock):
+            try:
+                from ray_tpu.core.object_store import FastStoreClient
+                self._fastpath = FastStoreClient(sock)
+            except Exception as e:
+                logger.debug("store fast path unavailable: %r", e)
+                self._fastpath = None
+        return self._fastpath
 
     def put_inline_marker(self, oid: bytes, sv) -> None:
         """Synchronously register a small ref-free owned object (e.g. a
@@ -1011,6 +1104,7 @@ class CoreWorker:
             if info is not None:
                 sdir = info["dir"] if os.path.isdir(info["dir"]) else ""
                 self._store_dir_cache = sdir
+                self._fp_sock = info.get("fastpath_sock", "")
             else:
                 sdir = ""
 
@@ -1042,6 +1136,16 @@ class CoreWorker:
                 await self.agent.call("store_ingest", oid, name,
                                       sv.total_size, len(meta))
                 return
+            except FileExistsError:
+                # A prior fast-path ingest COMMITTED but its response
+                # was lost: the object is already stored (puts are
+                # idempotent — a fresh oid can only collide with its own
+                # earlier attempt). Treat as success.
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return
             except OSError:
                 # Write failed (e.g. tmpfs ENOSPC before the store could
                 # account/evict): clean up and fall through to the
@@ -1065,8 +1169,15 @@ class CoreWorker:
             _write_at(path, os.O_RDWR)
         await self.agent.call("store_seal", oid, None, total)
 
+    _FAST_MISS = object()  # sentinel: fast get not applicable
+
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
             ) -> List[Any]:
+        if len(refs) == 1:
+            out = self._try_fast_get(refs[0])
+            if out is not self._FAST_MISS:
+                return [out]
+
         async def _gather():
             return await asyncio.gather(
                 *[self.get_async(r, timeout) for r in refs])
@@ -1075,6 +1186,67 @@ class CoreWorker:
             return list(self._run(_gather()).result())
         except asyncio.TimeoutError:
             raise GetTimeoutError(f"get timed out after {timeout}s")
+
+    def _try_fast_get(self, ref: ObjectRef):
+        """Synchronous get for the common local case — a READY
+        self-owned object that is inline, map-cached, or resident in the
+        local store — without an event-loop round-trip (READY is a
+        terminal state, so reading the entry off-loop is safe; the C
+        sidecar does the pin/release)."""
+        if not self._is_self_owned(ref):
+            return self._FAST_MISS
+        e = self.objects.get(ref.binary())
+        if e is None or e.state != READY:
+            return self._FAST_MISS
+        oid = ref.binary()
+        if e.inline is not None:
+            return serialization.deserialize(e.inline[0], e.inline[1])
+        with self._map_cache_lock:
+            mo = self._map_cache.get(oid)
+            if mo is not None:
+                self._map_cache.move_to_end(oid)
+        if mo is not None:
+            return serialization.deserialize(mo.data, bytes(mo.meta))
+        fp = self._fastpath if self._fastpath_probed else \
+            self._get_fastpath()
+        if fp is None or (self.node_id, tuple(self.agent_addr)) not in \
+                e.locations:
+            return self._FAST_MISS
+        try:
+            got = fp.get(oid)
+        except OSError:
+            return self._FAST_MISS
+        if got is None:  # evicted/spilled locally: loop path restores
+            return self._FAST_MISS
+        path, ds, ms = got
+        try:
+            mo = MappedObject(path, ds, ms)
+        except OSError:
+            fp.release(oid)
+            return self._FAST_MISS
+        try:
+            self._map_cache_put(oid, mo, ds, ms)
+            return serialization.deserialize(mo.data, bytes(mo.meta))
+        finally:
+            fp.release(oid)
+
+    def _map_cache_put(self, oid: bytes, mo, ds: int, ms: int) -> None:
+        """Insert into the byte-bounded mapping cache (lock-guarded: the
+        sync fast path and the loop path both mutate it). Subtracts any
+        replaced entry so concurrent misses for one oid can't drift the
+        accounting upward."""
+        if ds + ms > self._MAP_CACHE_ENTRY_MAX:
+            return
+        with self._map_cache_lock:
+            prev = self._map_cache.get(oid)
+            if prev is not None:
+                self._map_cache_bytes -= len(prev.data) + len(prev.meta)
+            self._map_cache[oid] = mo
+            self._map_cache_bytes += ds + ms
+            while (self._map_cache
+                   and self._map_cache_bytes > self._MAP_CACHE_MAX_BYTES):
+                _, old = self._map_cache.popitem(last=False)
+                self._map_cache_bytes -= len(old.data) + len(old.meta)
 
     def get_future(self, ref: ObjectRef) -> concurrent.futures.Future:
         return self._run(self.get_async(ref))
@@ -1166,9 +1338,11 @@ class CoreWorker:
     _MAP_CACHE_ENTRY_MAX = 4 * 1024 * 1024
 
     async def _map_local(self, oid: bytes) -> Any:
-        mo = self._map_cache.get(oid)
+        with self._map_cache_lock:
+            mo = self._map_cache.get(oid)
+            if mo is not None:
+                self._map_cache.move_to_end(oid)
         if mo is not None:
-            self._map_cache.move_to_end(oid)
             return serialization.deserialize(mo.data, bytes(mo.meta))
         got = await self.agent.call("store_get", oid)
         if got is None:
@@ -1176,19 +1350,7 @@ class CoreWorker:
         path, ds, ms = got
         try:
             mo = MappedObject(path, ds, ms)
-            if ds + ms <= self._MAP_CACHE_ENTRY_MAX:
-                # Two concurrent misses for the same oid can interleave
-                # across the store_get await: on overwrite, subtract the
-                # replaced entry's bytes so accounting can't drift upward.
-                prev = self._map_cache.get(oid)
-                if prev is not None:
-                    self._map_cache_bytes -= len(prev.data) + len(prev.meta)
-                self._map_cache[oid] = mo
-                self._map_cache_bytes += ds + ms
-                while (self._map_cache
-                       and self._map_cache_bytes > self._MAP_CACHE_MAX_BYTES):
-                    old_oid, old = self._map_cache.popitem(last=False)
-                    self._map_cache_bytes -= len(old.data) + len(old.meta)
+            self._map_cache_put(oid, mo, ds, ms)
             # Deserialized arrays keep views into the mapping alive; the pin
             # can be dropped immediately (tmpfs pages live until munmap).
             return serialization.deserialize(mo.data, bytes(mo.meta))
@@ -1196,9 +1358,10 @@ class CoreWorker:
             await self.agent.call("store_release", oid)
 
     def _drop_map_cache(self, oid: bytes) -> None:
-        mo = self._map_cache.pop(oid, None)
-        if mo is not None:
-            self._map_cache_bytes -= len(mo.data) + len(mo.meta)
+        with self._map_cache_lock:
+            mo = self._map_cache.pop(oid, None)
+            if mo is not None:
+                self._map_cache_bytes -= len(mo.data) + len(mo.meta)
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[list, list]:

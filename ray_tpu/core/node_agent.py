@@ -212,6 +212,25 @@ class NodeAgent:
         # Same-host clients skip the TCP loopback stack: a unix socket
         # shaves ~30% off every store/lease RPC (reference: raylet IPC is
         # a unix socket too, src/ray/ipc/).
+        # Native fast-path sidecar: a C server thread in this process
+        # serves workers' hot object ops (put-ingest/get/release/delete)
+        # straight against the shm store — no event loop on the data
+        # path. Lifecycle events flow back through the notify pipe into
+        # the asyncio loop so Python keeps the primary ledger and seal
+        # waiters authoritative (reference: the plasma store socket,
+        # plasma/store_runner.cc).
+        self._fastpath = None
+        if GlobalConfig.store_fastpath:
+            try:
+                from ray_tpu.core.object_store import StoreSidecar
+                fp_sock = os.path.join(self.session_dir,
+                                       f"store-{self.node_id.hex()[:8]}.sock")
+                self._fastpath = StoreSidecar(self.store, fp_sock)
+                asyncio.get_running_loop().add_reader(
+                    self._fastpath.notify_fd, self._drain_fastpath_events)
+            except Exception as e:
+                logger.warning("store fast path disabled: %r", e)
+                self._fastpath = None
         self._sock_path = os.path.join(self.session_dir,
                                        f"agent-{self.port}.sock")
         try:
@@ -996,9 +1015,30 @@ class NodeAgent:
             lambda: self.store.create(ObjectID(oid), data_size, meta_size),
             data_size + meta_size)
 
+    def _drain_fastpath_events(self) -> None:
+        """Runs on the event loop when the sidecar journal signals:
+        apply the bookkeeping Python owns for objects the C path
+        admitted/deleted."""
+        try:
+            events = self._fastpath.drain()
+        except Exception as e:
+            logger.warning("fastpath drain failed: %r", e)
+            return
+        for op, oid, size in events:
+            if op == 1:  # ingest (admitted pinned = primary copy)
+                self._primary[oid] = size
+                ev = self._seal_waiters.pop(oid, None)
+                if ev:
+                    ev.set()
+            elif op == 4:  # delete
+                self._primary.pop(oid, None)
+                self._drop_spilled(oid)
+
     async def store_info(self) -> dict:
         """Store facts a local worker needs for the direct-write put path."""
-        return {"dir": self.store.dir}
+        return {"dir": self.store.dir,
+                "fastpath_sock": (self._fastpath.sock_path
+                                  if self._fastpath else "")}
 
     async def _with_spill_retry(self, op, total: int):
         """Run a store-admission op, spilling/queueing on full (shared
@@ -1448,6 +1488,13 @@ class NodeAgent:
 
     async def shutdown_node(self) -> None:
         self._shutdown = True
+        if self._fastpath is not None:
+            try:
+                asyncio.get_running_loop().remove_reader(
+                    self._fastpath.notify_fd)
+                self._fastpath.stop()
+            except Exception:
+                pass
         for w in self.workers.values():
             if w.proc.poll() is None:
                 try:

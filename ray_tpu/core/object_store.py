@@ -85,6 +85,23 @@ def _load_lib() -> ctypes.CDLL:
                "store_num_evictions"):
         getattr(lib, fn).restype = ctypes.c_uint64
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    # Fast-path sidecar (store_server.cc).
+    lib.store_server_start.restype = ctypes.c_void_p
+    lib.store_server_start.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int)]
+    lib.store_server_drain.restype = ctypes.c_int
+    lib.store_server_drain.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.store_client_connect.restype = ctypes.c_int
+    lib.store_client_connect.argtypes = [ctypes.c_char_p]
+    lib.store_client_request.restype = ctypes.c_int
+    lib.store_client_request.argtypes = [
+        ctypes.c_int, ctypes.c_uint8, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_char_p, ctypes.c_int]
+    lib.store_client_close.argtypes = [ctypes.c_int]
     return lib
 
 
@@ -205,6 +222,115 @@ class LocalObjectStore:
         if self._handle:
             self._lib.store_destroy(self._handle)
             self._handle = None
+
+
+class StoreSidecar:
+    """Agent-side handle to the native fast-path server thread
+    (csrc/store_server.cc): shares the LocalObjectStore's handle, serves
+    workers over a unix socket with zero event-loop work, and feeds
+    lifecycle events (ingest/delete) back through `drain()` so Python
+    keeps owning the object-lifecycle bookkeeping."""
+
+    EVENT_SIZE = 29  # u8 op | 20B oid | u64 size
+
+    def __init__(self, store: LocalObjectStore, sock_path: str):
+        self._lib = _get_lib()
+        fd = ctypes.c_int(-1)
+        self._handle = self._lib.store_server_start(
+            store._handle, sock_path.encode(), ctypes.byref(fd))
+        if not self._handle:
+            raise OSError("could not start store fast-path server")
+        self.notify_fd = fd.value
+        self.sock_path = sock_path
+        self._buf = ctypes.create_string_buffer(self.EVENT_SIZE * 256)
+
+    def drain(self):
+        """-> [(op, oid_bytes, size)] accumulated since the last call."""
+        out = []
+        while True:
+            n = self._lib.store_server_drain(self._handle, self._buf,
+                                             len(self._buf))
+            raw = self._buf.raw[:n]
+            for i in range(0, n, self.EVENT_SIZE):
+                rec = raw[i:i + self.EVENT_SIZE]
+                out.append((rec[0], rec[1:21],
+                            int.from_bytes(rec[21:29], "little")))
+            if n < len(self._buf):
+                return out
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.store_server_stop(self._handle)
+            self._handle = None
+
+
+class FastStoreClient:
+    """Worker-side blocking client to the agent's fast-path sidecar: one
+    persistent unix-socket connection, one C round-trip per op — no
+    event loop on either side (the analogue of the reference's plasma
+    client socket, reference: plasma/client.cc)."""
+
+    OP_INGEST, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS = 1, 2, 3, 4, 5
+
+    def __init__(self, sock_path: str):
+        import threading
+        self._lib = _get_lib()
+        self._sock_path = sock_path
+        self._fd = self._lib.store_client_connect(sock_path.encode())
+        if self._fd < 0:
+            raise OSError(f"cannot connect store fast path {sock_path}")
+        self._lock = threading.Lock()
+        self._rc = ctypes.c_int32()
+        self._ds = ctypes.c_uint64()
+        self._ms = ctypes.c_uint64()
+        self._path = ctypes.create_string_buffer(4096)
+
+    def _req(self, op: int, oid: bytes, a: int = 0, b: int = 0,
+             name: Optional[bytes] = None) -> Tuple[int, int, int, str]:
+        with self._lock:
+            if self._fd < 0:  # previous transport error: reconnect once
+                self._fd = self._lib.store_client_connect(
+                    self._sock_path.encode())
+                if self._fd < 0:
+                    raise OSError("store fast path unreachable")
+            ok = self._lib.store_client_request(
+                self._fd, op, oid, a, b, name, ctypes.byref(self._rc),
+                ctypes.byref(self._ds), ctypes.byref(self._ms),
+                self._path, 4096)
+            if ok != 0:
+                # NEVER reuse a desynced connection: a partial write/read
+                # would make the next op parse this op's stale reply.
+                self._lib.store_client_close(self._fd)
+                self._fd = -1
+                raise OSError("store fast path connection lost")
+            return (self._rc.value, self._ds.value, self._ms.value,
+                    self._path.value.decode())
+
+    def ingest(self, oid: bytes, name: str, data_size: int,
+               meta_size: int) -> int:
+        rc, _, _, _ = self._req(self.OP_INGEST, oid, data_size, meta_size,
+                                name.encode())
+        return rc
+
+    def get(self, oid: bytes) -> Optional[Tuple[str, int, int]]:
+        rc, ds, ms, path = self._req(self.OP_GET, oid)
+        if rc != 0:
+            return None
+        return path, ds, ms
+
+    def release(self, oid: bytes) -> None:
+        self._req(self.OP_RELEASE, oid)
+
+    def delete(self, oid: bytes) -> int:
+        return self._req(self.OP_DELETE, oid)[0]
+
+    def contains(self, oid: bytes) -> int:
+        return self._req(self.OP_CONTAINS, oid)[0]
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            self._lib.store_client_close(self._fd)
+            self._fd = -1
 
 
 class MappedObject:
