@@ -31,6 +31,7 @@
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 #include <fcntl.h>
@@ -132,6 +133,10 @@ void* ConnLoop(void* argp) {
   char oid[kIdSize];
   char name[512];
   char path[4096];
+  // Per-connection pin ledger: a client that dies between GET and
+  // RELEASE must not leak pins (the reference plasma store releases a
+  // disconnected client's pins the same way).
+  std::unordered_map<std::string, int> pins;
   for (;;) {
     uint8_t op;
     uint64_t a, b;
@@ -166,11 +171,17 @@ void* ConnLoop(void* argp) {
       }
       case kOpGet:
         rc = store_get(s->store, oid, path, sizeof(path), &ds, &ms);
-        if (rc == 0) plen = (uint16_t)std::strlen(path);
+        if (rc == 0) {
+          plen = (uint16_t)std::strlen(path);
+          pins[std::string(oid, kIdSize)]++;
+        }
         break;
-      case kOpRelease:
+      case kOpRelease: {
         rc = store_release(s->store, oid);
+        auto it = pins.find(std::string(oid, kIdSize));
+        if (it != pins.end() && --it->second <= 0) pins.erase(it);
         break;
+      }
       case kOpDelete:
         rc = store_delete(s->store, oid);
         // Journal even when the store never had it (-1): the Python
@@ -187,6 +198,12 @@ void* ConnLoop(void* argp) {
         !WriteFull(fd, &ms, 8) || !WriteFull(fd, &plen, 2) ||
         (plen && !WriteFull(fd, path, plen))) {
       break;
+    }
+  }
+  // Release any pins this client still held (died mid GET..RELEASE).
+  for (const auto& kv : pins) {
+    for (int i = 0; i < kv.second; i++) {
+      store_release(s->store, kv.first.data());
     }
   }
   {

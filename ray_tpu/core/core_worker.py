@@ -217,6 +217,7 @@ class CoreWorker:
         # lazily alongside the store dir; None = unavailable.
         self._fastpath = None
         self._fastpath_probed = False
+        self._fastpath_lock = threading.Lock()  # probe + ingest naming
         self._map_cache_lock = threading.Lock()
         self._ingest_seq = 0
         # Per-peer batched store frees (flushed on the next loop tick).
@@ -999,8 +1000,10 @@ class CoreWorker:
         if fp is None or total > 4 * 1024 * 1024:
             return False
         sdir = self._store_dir_cache
-        self._ingest_seq += 1
-        name = f"ingest-{os.getpid()}-{self._ingest_seq}"
+        with self._fastpath_lock:  # puts run on arbitrary user threads
+            self._ingest_seq += 1
+            seq = self._ingest_seq
+        name = f"ingest-{os.getpid()}-{seq}"
         path = os.path.join(sdir, name)
         try:
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
@@ -1032,9 +1035,13 @@ class CoreWorker:
 
     def _get_fastpath(self):
         """Connect the C sidecar client once (probing store_info on the
-        loop if the dir cache is cold)."""
+        loop if the dir cache is cold). Lock-guarded: concurrent first
+        puts from user threads must not double-connect."""
         if self._fastpath_probed:
             return self._fastpath
+        # Probe OUTSIDE the lock: _run().result() waits on the event
+        # loop, and the loop thread itself takes _fastpath_lock briefly
+        # in _store_put — holding it across the wait could deadlock.
         if self._store_dir_cache is None:
             try:
                 info = self._run(self.agent.call("store_info")).result(10)
@@ -1044,15 +1051,18 @@ class CoreWorker:
                 self._fp_sock = info.get("fastpath_sock", "")
             except Exception:
                 return None
-        self._fastpath_probed = True
-        sock = getattr(self, "_fp_sock", "")
-        if self._store_dir_cache and sock and os.path.exists(sock):
-            try:
-                from ray_tpu.core.object_store import FastStoreClient
-                self._fastpath = FastStoreClient(sock)
-            except Exception as e:
-                logger.debug("store fast path unavailable: %r", e)
-                self._fastpath = None
+        with self._fastpath_lock:
+            if self._fastpath_probed:
+                return self._fastpath
+            sock = getattr(self, "_fp_sock", "")
+            if self._store_dir_cache and sock and os.path.exists(sock):
+                try:
+                    from ray_tpu.core.object_store import FastStoreClient
+                    self._fastpath = FastStoreClient(sock)
+                except Exception as e:
+                    logger.debug("store fast path unavailable: %r", e)
+                    self._fastpath = None
+            self._fastpath_probed = True
         return self._fastpath
 
     def put_inline_marker(self, oid: bytes, sv) -> None:
@@ -1121,8 +1131,10 @@ class CoreWorker:
 
         loop = asyncio.get_running_loop()
         if sdir:
-            self._ingest_seq += 1
-            name = f"ingest-{os.getpid()}-{self._ingest_seq}"
+            with self._fastpath_lock:  # shared with user-thread fast puts
+                self._ingest_seq += 1
+                seq = self._ingest_seq
+            name = f"ingest-{os.getpid()}-{seq}"
             path = os.path.join(sdir, name)
             flags = os.O_CREAT | os.O_RDWR | os.O_EXCL
             try:
@@ -1222,13 +1234,22 @@ class CoreWorker:
         try:
             mo = MappedObject(path, ds, ms)
         except OSError:
-            fp.release(oid)
+            self._fp_release_quiet(fp, oid)
             return self._FAST_MISS
         try:
             self._map_cache_put(oid, mo, ds, ms)
             return serialization.deserialize(mo.data, bytes(mo.meta))
         finally:
+            # A lost sidecar connection must not fail a get that already
+            # read its data (the server releases a dead client's pins).
+            self._fp_release_quiet(fp, oid)
+
+    @staticmethod
+    def _fp_release_quiet(fp, oid: bytes) -> None:
+        try:
             fp.release(oid)
+        except OSError:
+            pass
 
     def _map_cache_put(self, oid: bytes, mo, ds: int, ms: int) -> None:
         """Insert into the byte-bounded mapping cache (lock-guarded: the

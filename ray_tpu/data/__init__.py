@@ -10,12 +10,15 @@ from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
 from ray_tpu.data.dataset import (DataIterator, Dataset, from_blocks,
                                   from_items, from_numpy, range,  # noqa: A004
                                   read_binary_files, read_csv, read_images,
-                                  read_json, read_parquet, read_text)
+                                  read_json, read_lance, read_parquet,
+                                  read_text, read_webdataset)
+from ray_tpu.data import preprocessors
 
 __all__ = [
     "Block", "BlockAccessor", "concat_blocks",
     "Dataset", "DataIterator",
     "range", "from_items", "from_numpy", "from_blocks",
     "read_parquet", "read_csv", "read_json", "read_text",
-    "read_binary_files", "read_images",
+    "read_binary_files", "read_images", "read_webdataset",
+    "read_lance", "preprocessors",
 ]

@@ -668,3 +668,21 @@ def read_images(paths, *, size=None, mode: Optional[str] = None) -> Dataset:
     ray.data.read_images; size=(w, h) resizes, mode converts e.g. "RGB")."""
     return Dataset(_ds.image_read_tasks(paths, size=size, mode=mode),
                    name="read_images")
+
+
+def read_webdataset(paths, *, rows_per_block: int = 256,
+                    decode: bool = True) -> Dataset:
+    """Webdataset tar shards: one row per sample keyed by the dotted
+    file-name prefix, columns per extension plus "__key__" (reference:
+    ray.data.read_webdataset / _internal/datasource/
+    webdataset_datasource.py). `decode=False` keeps raw bytes."""
+    return Dataset(_ds.webdataset_read_tasks(
+        paths, rows_per_block=rows_per_block, decode=decode),
+        name="read_webdataset")
+
+
+def read_lance(uri, *, columns: Optional[List[str]] = None) -> Dataset:
+    """Lance dataset fragments (reference: ray.data.read_lance); needs
+    the optional `lance` package."""
+    return Dataset(_ds.lance_read_tasks(uri, columns=columns),
+                   name="read_lance")

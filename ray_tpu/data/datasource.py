@@ -18,12 +18,29 @@ import numpy as np
 DEFAULT_ROWS_PER_BLOCK = 64 * 1024
 
 
+def _is_url(p: str) -> bool:
+    return "://" in p
+
+
 def _expand_paths(paths) -> List[str]:
     if isinstance(paths, str):
         paths = [paths]
     out: List[str] = []
     for p in paths:
-        if os.path.isdir(p):
+        if _is_url(p):
+            # Remote paths: fsspec expands globs on filesystem-like
+            # protocols; http(s) URLs pass through verbatim — a '?'
+            # there is a query string, not a glob (reference:
+            # datasource paths ride pyarrow.fs/fsspec).
+            proto = p.split("://", 1)[0].lower()
+            if proto not in ("http", "https") \
+                    and any(ch in p for ch in "*?["):
+                import fsspec
+                fs, _ = fsspec.core.url_to_fs(p)
+                out.extend(f"{proto}://{m}" for m in sorted(fs.glob(p)))
+            else:
+                out.append(p)
+        elif os.path.isdir(p):
             for root, _, files in os.walk(p):
                 out.extend(os.path.join(root, f) for f in sorted(files)
                            if not f.startswith("."))
@@ -34,6 +51,17 @@ def _expand_paths(paths) -> List[str]:
     if not out:
         raise FileNotFoundError(f"no files matched {paths!r}")
     return out
+
+
+def _open_any(path: str, mode: str = "rb"):
+    """Open local paths with open(); URLs (s3://, gs://, http://, ...)
+    through fsspec — every file-based reader accepts either."""
+    if _is_url(path):
+        import fsspec
+        return fsspec.open(path, mode).open()
+    if "b" in mode:
+        return open(path, mode)
+    return open(path, mode, encoding="utf-8")
 
 
 def range_read_tasks(n: int, num_blocks: Optional[int] = None
@@ -98,7 +126,7 @@ def parquet_read_tasks(paths, columns: Optional[List[str]] = None):
     for path in files:
         def read(path=path, columns=columns):
             import pyarrow.parquet as pq
-            f = pq.ParquetFile(path)
+            f = pq.ParquetFile(_open_any(path) if _is_url(path) else path)
             for rg in range(f.num_row_groups):
                 yield f.read_row_group(rg, columns=columns)
 
@@ -112,7 +140,8 @@ def csv_read_tasks(paths, **read_options):
     for path in files:
         def read(path=path):
             import pyarrow.csv as pacsv
-            yield pacsv.read_csv(path)
+            yield pacsv.read_csv(_open_any(path) if _is_url(path)
+                                 else path)
 
         tasks.append(read)
     return tasks
@@ -124,7 +153,8 @@ def json_read_tasks(paths):
     for path in files:
         def read(path=path):
             import pyarrow.json as pajson
-            yield pajson.read_json(path)
+            yield pajson.read_json(_open_any(path) if _is_url(path)
+                                   else path)
 
         tasks.append(read)
     return tasks
@@ -137,8 +167,8 @@ def text_read_tasks(paths, *, encoding: str = "utf-8"):
     tasks = []
     for path in files:
         def read(path=path):
-            with open(path, encoding=encoding) as f:
-                lines = f.read().splitlines()
+            with _open_any(path, "rb") as f:
+                lines = f.read().decode(encoding).splitlines()
             yield {"text": np.asarray(lines, dtype=object)}
 
         tasks.append(read)
@@ -152,7 +182,7 @@ def binary_read_tasks(paths, *, include_paths: bool = False):
     tasks = []
     for path in files:
         def read(path=path):
-            with open(path, "rb") as f:
+            with _open_any(path, "rb") as f:
                 payload = f.read()
             block = {"bytes": np.asarray([payload], dtype=object)}
             if include_paths:
@@ -173,12 +203,103 @@ def image_read_tasks(paths, *, size=None, mode: Optional[str] = None):
     for path in files:
         def read(path=path):
             from PIL import Image
-            img = Image.open(path)
+            img = Image.open(_open_any(path) if _is_url(path) else path)
             if mode is not None:
                 img = img.convert(mode)
             if size is not None:
                 img = img.resize(tuple(size))
             yield {"image": np.asarray(img)[None]}
+
+        tasks.append(read)
+    return tasks
+
+
+def _decode_wds_field(ext: str, payload: bytes):
+    """Default webdataset field decoders by extension (reference:
+    _internal/datasource/webdataset_datasource.py default_decoder)."""
+    if ext in ("txt", "text"):
+        return payload.decode("utf-8")
+    if ext == "json":
+        import json as _json
+        return _json.loads(payload)
+    if ext in ("cls", "cls2", "index"):
+        return int(payload.decode("utf-8").strip())
+    if ext in ("jpg", "jpeg", "png", "ppm", "pgm", "pbm", "bmp"):
+        import io as _io
+
+        from PIL import Image
+        return np.asarray(Image.open(_io.BytesIO(payload)))
+    if ext in ("npy",):
+        import io as _io
+        return np.load(_io.BytesIO(payload), allow_pickle=False)
+    return payload  # unknown extension: raw bytes
+
+
+def webdataset_read_tasks(paths, *, rows_per_block: int = 256,
+                          decode: bool = True):
+    """Stream samples out of webdataset-convention tar shards: files
+    sharing a dotted key prefix form one sample ({"__key__", ext: value})
+    (reference: _internal/datasource/webdataset_datasource.py). One task
+    per shard; samples batch into blocks of `rows_per_block`."""
+    files = _expand_paths(paths)
+    tasks = []
+    for path in files:
+        def read(path=path):
+            import tarfile
+
+            def flush(rows):
+                cols = sorted({k for r in rows for k in r})
+                return {c: np.asarray([r.get(c) for r in rows],
+                                      dtype=object) for c in cols}
+
+            rows: List[dict] = []
+            sample: dict = {}
+            key = None
+            with _open_any(path, "rb") as f, \
+                    tarfile.open(fileobj=f, mode="r|*") as tar:
+                for member in tar:
+                    if not member.isfile():
+                        continue
+                    base = os.path.basename(member.name)
+                    stem, _, ext = base.partition(".")
+                    if key is not None and stem != key and sample:
+                        rows.append(sample)
+                        sample = {}
+                        if len(rows) >= rows_per_block:
+                            yield flush(rows)
+                            rows = []
+                    key = stem
+                    payload = tar.extractfile(member).read()
+                    sample["__key__"] = stem
+                    sample[ext] = (_decode_wds_field(ext.lower(), payload)
+                                   if decode else payload)
+            if sample:
+                rows.append(sample)
+            if rows:
+                yield flush(rows)
+
+        tasks.append(read)
+    return tasks
+
+
+def lance_read_tasks(uri, columns: Optional[List[str]] = None):
+    """Lance dataset fragments as read tasks (reference:
+    _internal/datasource/lance_datasource.py). Gated on the optional
+    `lance` package — the seam matches the reference; environments
+    without lance get a clear error instead of a silent fallback."""
+    try:
+        import lance  # type: ignore
+    except ImportError as e:
+        raise ImportError(
+            "read_lance requires the 'lance' package (pip install "
+            "pylance); not bundled in this environment") from e
+    ds = lance.dataset(uri)
+    tasks = []
+    for frag in ds.get_fragments():
+        def read(frag=frag, columns=columns):
+            for batch in frag.to_batches(columns=columns):
+                import pyarrow as pa
+                yield pa.Table.from_batches([batch])
 
         tasks.append(read)
     return tasks

@@ -38,15 +38,26 @@ logger = get_logger("data.streaming_executor")
 
 # Global in-flight task budget split across task-launching operators
 # (reference: ReservationOpResourceAllocator's reservation ratio over the
-# cluster resource budget, collapsed to task slots — block memory follows
-# task count here because every task's output window is bounded by the
-# runtime's generator backpressure).
+# cluster resource budget; here BOTH a task-slot budget and a BYTE budget
+# apply — slots bound cold-start concurrency, bytes bound steady-state
+# memory once block sizes are observed).
 DEFAULT_TASK_BUDGET = 8
 
 # Per-edge queue cap: an op stops dispatching when this many of its output
 # blocks sit undispatched in the downstream op's input queue (reference:
 # OutputQueueSizeBackpressurePolicy).
 DEFAULT_EDGE_QUEUE_CAP = 16
+
+
+def _default_memory_budget() -> int:
+    from ray_tpu.utils.config import GlobalConfig
+    b = GlobalConfig.data_memory_budget_bytes
+    if b > 0:
+        return b
+    # A quarter of the local object store: leaves room for task args,
+    # other datasets, and non-Data objects.
+    return max(64 * 1024 * 1024,
+               GlobalConfig.object_store_memory_bytes // 4)
 
 
 class OpState:
@@ -58,6 +69,13 @@ class OpState:
         self.downstream: Optional[Tuple["OpState", Optional[int]]] = None
         self.upstreams: List["OpState"] = []
         self.done_notified = False
+        # Byte accounting for blocks queued at THIS op's input (sizes
+        # parallel the op's input deque for launcher ops; Concat tracks
+        # per-branch totals).
+        self.in_sizes: deque = deque()
+        self.in_bytes = 0
+        self.branch_in_bytes: Dict[int, int] = {}
+        self.branch_in_sizes: Dict[int, deque] = {}
 
     @property
     def name(self) -> str:
@@ -73,9 +91,12 @@ class ResourceManager:
     queue exceeds the edge cap."""
 
     def __init__(self, ops: List[OpState], budget: int = DEFAULT_TASK_BUDGET,
-                 edge_queue_cap: int = DEFAULT_EDGE_QUEUE_CAP):
+                 edge_queue_cap: int = DEFAULT_EDGE_QUEUE_CAP,
+                 memory_budget: Optional[int] = None):
         self.budget = max(1, budget)
         self.edge_queue_cap = edge_queue_cap
+        self.memory_budget = (memory_budget if memory_budget is not None
+                              else _default_memory_budget())
         # Barrier (AllToAll) ops run driver-side outside the slot budget,
         # so they neither reserve nor consume shares.
         self._launchers = [
@@ -85,6 +106,48 @@ class ResourceManager:
         n = max(1, len(self._launchers))
         self._reserved = max(1, self.budget // n)
         self._shared_pool = max(0, self.budget - self._reserved * n)
+        # Byte budget split the same way: each launcher owns a reserved
+        # share; the remainder is a shared pool (reference:
+        # resource_manager.py:363 ReservationOpResourceAllocator, whose
+        # core abstraction is MEMORY — slot budgets alone cannot prevent
+        # OOM when block sizes vary 10x between ops).
+        self._mem_reserved = max(1, self.memory_budget // n)
+        self._mem_shared = max(0, self.memory_budget
+                               - self._mem_reserved * n)
+        self.peak_mem_used = 0
+        self._sink_bytes_fn = lambda: 0  # wired by the executor
+
+    # Pessimistic per-task output estimate until the op's first task
+    # finishes (the reference similarly charges an assumed block size
+    # before sizes are observed — a zero cold estimate would let the
+    # full slot budget launch before the byte budget could engage).
+    COLD_TASK_BYTES = 2 * 1024 * 1024
+
+    @classmethod
+    def _est_task_bytes(cls, state: OpState) -> int:
+        """Expected output bytes of ONE task of this op, from observed
+        blocks (pessimistic constant until the first task finishes)."""
+        m = state.op.metrics
+        if m.tasks_finished <= 0:
+            return cls.COLD_TASK_BYTES
+        return m.bytes_out_estimate // m.tasks_finished
+
+    def _mem_used(self, state: OpState) -> int:
+        """Bytes attributable to this op: its unconsumed output blocks
+        (queued at the downstream input / executor sink) plus the
+        expected output of its in-flight tasks."""
+        down = state.downstream
+        if down is None:
+            queued = self._sink_bytes_fn()
+        else:
+            target, branch = down
+            queued = (target.branch_in_bytes.get(branch, 0)
+                      if branch is not None else target.in_bytes)
+        return queued + state.op.num_active_tasks() \
+            * self._est_task_bytes(state)
+
+    def mem_usage(self) -> Dict[str, int]:
+        return {s.name: self._mem_used(s) for s in self._launchers}
 
     def can_launch(self, state: OpState) -> bool:
         op = state.op
@@ -93,10 +156,30 @@ class ResourceManager:
         actives = [s.op.num_active_tasks() for s in self._launchers]
         if sum(actives) >= self.budget:
             return False  # absolute cap — borrows never exceed the budget
-        if op.num_active_tasks() < self._reserved:
-            return True  # within reserved share
-        shared_used = sum(max(0, a - self._reserved) for a in actives)
-        return shared_used < self._shared_pool
+        if op.num_active_tasks() >= self._reserved:
+            shared_used = sum(max(0, a - self._reserved) for a in actives)
+            if shared_used >= self._shared_pool:
+                return False
+        # Byte budget: would this launch push the op past its memory
+        # allowance (reserved share, then the shared byte pool)?
+        est = self._est_task_bytes(state)
+        used = {s.name: self._mem_used(s) for s in self._launchers}
+        total = sum(used.values())
+        self.peak_mem_used = max(self.peak_mem_used, total)
+        mine = used.get(state.name, 0)
+        if mine + est > self._mem_reserved:
+            # Progress guarantee: an op with NOTHING in flight and
+            # nothing queued may always launch one task, even when a
+            # single task's estimate exceeds its whole allowance —
+            # otherwise an oversized block (or a budget below the cold
+            # estimate) would wedge the pipeline forever.
+            if op.num_active_tasks() == 0 and mine == 0:
+                return True
+            mem_shared_used = sum(max(0, u - self._mem_reserved)
+                                  for u in used.values())
+            if mem_shared_used + est > self._mem_shared:
+                return False
+        return True
 
     def output_blocked(self, state: OpState, sink_queue_len: int) -> bool:
         down = state.downstream
@@ -118,12 +201,17 @@ class StreamingExecutor:
 
     def __init__(self, states: List[OpState],
                  task_budget: int = DEFAULT_TASK_BUDGET,
-                 edge_queue_cap: int = DEFAULT_EDGE_QUEUE_CAP):
+                 edge_queue_cap: int = DEFAULT_EDGE_QUEUE_CAP,
+                 memory_budget: Optional[int] = None):
         self._states = states
         self._sink = states[-1]
         assert self._sink.downstream is None
-        self._rm = ResourceManager(states, task_budget, edge_queue_cap)
+        self._rm = ResourceManager(states, task_budget, edge_queue_cap,
+                                   memory_budget)
         self._out_queue: deque = deque()
+        self._out_bytes = 0
+        self._out_sizes: deque = deque()
+        self._rm._sink_bytes_fn = lambda: self._out_bytes
         self._started = False
         self._shut = False
 
@@ -152,6 +240,11 @@ class StreamingExecutor:
         return {s.name: s.op.metrics for s in self._states}
 
     # -- internals ------------------------------------------------------
+    def _pop_output(self):
+        self._out_bytes -= self._out_sizes.popleft() if self._out_sizes \
+            else 0
+        return self._out_queue.popleft()
+
     def _next_output(self):
         if not self._started:
             self._started = True
@@ -159,10 +252,10 @@ class StreamingExecutor:
                 s.op.start()
         while True:
             if self._out_queue:
-                return self._out_queue.popleft()
+                return self._pop_output()
             progressed = self._step()
             if self._out_queue:
-                return self._out_queue.popleft()
+                return self._pop_output()
             if self._all_done():
                 return _DONE
             if not progressed:
@@ -192,22 +285,64 @@ class StreamingExecutor:
             while (s.op.can_dispatch()
                    and self._rm.can_launch(s)
                    and not self._rm.output_blocked(s, len(self._out_queue))):
+                before = s.op.num_queued_inputs()
                 if not s.op.dispatch():
                     break
+                # The op consumed inputs: retire their tracked sizes
+                # (launcher ops pop exactly one per dispatch; barrier
+                # ops drain in bulk inside poll and resync below).
+                consumed = before - s.op.num_queued_inputs()
+                for _ in range(consumed):
+                    if s.in_sizes:
+                        s.in_bytes -= s.in_sizes.popleft()
                 progressed = True
+        # Non-launcher ops (AllToAll/Concat) consume inputs inside
+        # poll(): resync their byte ledgers to the surviving queues.
+        for s in self._states:
+            if s.in_sizes and isinstance(s.op, AllToAllOperator):
+                q = s.op.num_queued_inputs()
+                while len(s.in_sizes) > q:
+                    s.in_bytes -= s.in_sizes.popleft()
+            if s.branch_in_sizes and isinstance(s.op, ConcatOperator):
+                for b, sizes in s.branch_in_sizes.items():
+                    q = len(s.op._branch_queues[b])
+                    while len(sizes) > q:
+                        s.branch_in_bytes[b] -= sizes.popleft()
         return progressed
 
+    @staticmethod
+    def _size_of(ref: Any) -> int:
+        """Byte size of a block ref from the owner's ledger (0 for
+        non-ref items such as pickled read callables)."""
+        try:
+            from ray_tpu.core.ref import ObjectRef, get_core_worker
+            if not isinstance(ref, ObjectRef):
+                return 0
+            e = get_core_worker().objects.get(ref.binary())
+            return int(e.size or 0) if e is not None else 0
+        except Exception:
+            return 0
+
     def _route(self, s: OpState, ref: Any) -> None:
+        size = self._size_of(ref)
+        s.op.metrics.bytes_out_estimate += size
         down = s.downstream
         if down is None:
             self._out_queue.append(ref)
+            self._out_sizes.append(size)
+            self._out_bytes += size
             return
         target, branch = down
         if branch is not None:
             assert isinstance(target.op, ConcatOperator)
             target.op.add_branch_input(branch, ref)
+            target.branch_in_bytes[branch] = \
+                target.branch_in_bytes.get(branch, 0) + size
+            target.branch_in_sizes.setdefault(branch, deque()).append(size)
         else:
             target.op.add_input(ref)
+            target.in_sizes.append(size)
+            target.in_bytes += size
 
     def _notify_done(self, s: OpState) -> None:
         down = s.downstream

@@ -64,6 +64,7 @@ _flag("task_retry_delay_ms", int, 100, "Delay before retrying a failed task.")
 # --- object store ---
 _flag("object_store_memory_bytes", int, 2 * 1024**3, "Default shm arena size per node.")
 _flag("store_fastpath", bool, True, "Native store sidecar: workers do put/get over a C unix-socket path (no event loop); falls back to agent RPC when off or unavailable.")
+_flag("data_memory_budget_bytes", int, 0, "Streaming Data executor byte budget for in-flight blocks; 0 = auto (object store / 4).")
 _flag("object_store_min_spill_bytes", int, 100 * 1024**2, "Batch spills until this many bytes.")
 _flag("max_direct_call_object_size", int, 100 * 1024, "Inline results smaller than this in-process.")
 _flag("object_transfer_chunk_bytes", int, 5 * 1024**2, "Chunk size for node-to-node object transfer.")
