@@ -33,13 +33,22 @@ def _expand_paths(paths) -> List[str]:
             # there is a query string, not a glob (reference:
             # datasource paths ride pyarrow.fs/fsspec).
             proto = p.split("://", 1)[0].lower()
-            if proto not in ("http", "https") \
-                    and any(ch in p for ch in "*?["):
+            if proto in ("http", "https"):
+                out.append(p)  # a '?' here is a query string, not a glob
+            elif any(ch in p for ch in "*?["):
                 import fsspec
                 fs, _ = fsspec.core.url_to_fs(p)
                 out.extend(f"{proto}://{m}" for m in sorted(fs.glob(p)))
             else:
-                out.append(p)
+                import fsspec
+                fs, root = fsspec.core.url_to_fs(p)
+                if fs.isdir(root):
+                    # Remote directory prefix: expand like the local
+                    # os.walk branch (s3://bucket/table/ reads its files).
+                    out.extend(f"{proto}://{m}"
+                               for m in sorted(fs.find(root)))
+                else:
+                    out.append(p)
         elif os.path.isdir(p):
             for root, _, files in os.walk(p):
                 out.extend(os.path.join(root, f) for f in sorted(files)
@@ -260,8 +269,14 @@ def webdataset_read_tasks(paths, *, rows_per_block: int = 256,
                 for member in tar:
                     if not member.isfile():
                         continue
-                    base = os.path.basename(member.name)
-                    stem, _, ext = base.partition(".")
+                    # Key on the FULL path minus extension: shards that
+                    # bundle directories (train/0001.jpg, val/0001.jpg)
+                    # must not merge same-basename samples.
+                    name = member.name
+                    base = os.path.basename(name)
+                    _, _, ext = base.partition(".")
+                    stem = name[: len(name) - len(ext) - 1] if ext \
+                        else name
                     if key is not None and stem != key and sample:
                         rows.append(sample)
                         sample = {}

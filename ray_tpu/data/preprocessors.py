@@ -158,9 +158,16 @@ class LabelEncoder(Preprocessor):
         out = dict(batch)
         idx = self._index
         vals = np.asarray(batch[self.column])
-        out[self.column] = np.asarray(
-            [idx[v if not isinstance(v, np.generic) else v.item()]
-             for v in vals], dtype=np.int64)
+        codes = np.empty(len(vals), np.int64)
+        for i, v in enumerate(vals):
+            v = v.item() if isinstance(v, np.generic) else v
+            code = idx.get(v)
+            if code is None:
+                raise ValueError(
+                    f"LabelEncoder({self.column!r}): value {v!r} was not "
+                    f"seen during fit (known: {self.classes_[:10]}...)")
+            codes[i] = code
+        out[self.column] = codes
         return out
 
 

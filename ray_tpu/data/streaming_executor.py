@@ -147,7 +147,10 @@ class ResourceManager:
             * self._est_task_bytes(state)
 
     def mem_usage(self) -> Dict[str, int]:
-        return {s.name: self._mem_used(s) for s in self._launchers}
+        out: Dict[str, int] = {}
+        for s in self._launchers:  # diagnostic view; names may repeat
+            out[s.name] = out.get(s.name, 0) + self._mem_used(s)
+        return out
 
     def can_launch(self, state: OpState) -> bool:
         op = state.op
@@ -163,10 +166,13 @@ class ResourceManager:
         # Byte budget: would this launch push the op past its memory
         # allowance (reserved share, then the shared byte pool)?
         est = self._est_task_bytes(state)
-        used = {s.name: self._mem_used(s) for s in self._launchers}
+        # Keyed by OpState IDENTITY: op names are not unique (every
+        # union branch is "read->map"), and a name collision would let
+        # same-named ops alias one ledger entry and overrun the budget.
+        used = {id(s): self._mem_used(s) for s in self._launchers}
         total = sum(used.values())
         self.peak_mem_used = max(self.peak_mem_used, total)
-        mine = used.get(state.name, 0)
+        mine = used.get(id(state), 0)
         if mine + est > self._mem_reserved:
             # Progress guarantee: an op with NOTHING in flight and
             # nothing queued may always launch one task, even when a

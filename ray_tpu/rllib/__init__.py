@@ -15,12 +15,20 @@ actors and a jitted JAX learner.
 """
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig
-from ray_tpu.rllib.env import CartPole, Env
+from ray_tpu.rllib.env import (CartPole, ContinuousEnv, CooperativeMatch,
+                               Env, MultiAgentEnv, Pendulum)
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
-from ray_tpu.rllib.learner import DQNLearner, IMPALALearner, PPOLearner
+from ray_tpu.rllib.learner import (DQNLearner, IMPALALearner, PPOLearner,
+                                   SACLearner)
+from ray_tpu.rllib.multi_agent import (MultiAgentEnvRunner, MultiAgentPPO,
+                                       MultiAgentPPOConfig)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.sac import SAC, SACConfig
 
-__all__ = ["CartPole", "DQN", "DQNConfig", "DQNLearner", "Env", "IMPALA",
-           "IMPALAConfig", "IMPALALearner", "PPO", "PPOConfig",
-           "PPOLearner", "PrioritizedReplayBuffer", "ReplayBuffer"]
+__all__ = ["CartPole", "ContinuousEnv", "CooperativeMatch", "DQN",
+           "DQNConfig", "DQNLearner", "Env", "IMPALA", "IMPALAConfig",
+           "IMPALALearner", "MultiAgentEnv", "MultiAgentEnvRunner",
+           "MultiAgentPPO", "MultiAgentPPOConfig", "PPO", "PPOConfig",
+           "PPOLearner", "Pendulum", "PrioritizedReplayBuffer",
+           "ReplayBuffer", "SAC", "SACConfig", "SACLearner"]

@@ -175,3 +175,47 @@ class EnvRunner:
             "dones": roll["terms"],
             "episode_returns": roll["episode_returns"],
         }
+
+    def sample_continuous(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Off-policy continuous-action collection with the squashed-
+        Gaussian behavior policy (SAC; reference: rllib/algorithms/sac
+        env-runner sampling). Own stepping loop — the shared _rollout
+        stores int actions. Truncations bootstrap (non-terminal dones);
+        `next_obs` at a boundary is the final pre-reset obs."""
+        pi = self._weights["pi"]
+        scale = float(self._weights.get("action_scale", 1.0))
+        env = self._env
+        asize = env.action_size
+        obs_buf = np.zeros((num_steps, env.observation_size), np.float32)
+        next_buf = np.zeros_like(obs_buf)
+        act_buf = np.zeros((num_steps, asize), np.float32)
+        rew_buf = np.zeros(num_steps, np.float32)
+        done_buf = np.zeros(num_steps, np.float32)
+
+        self._completed_returns = []
+        obs = self._obs
+        for t in range(num_steps):
+            out = _np_forward(pi, obs[None, :])[0]
+            mean, log_std = out[:asize], np.clip(out[asize:], -5.0, 2.0)
+            action = np.tanh(mean + np.exp(log_std)
+                             * self._rng.standard_normal(asize)) * scale
+            nxt, rew, term, trunc, _ = env.step(action.astype(np.float32))
+            obs_buf[t] = obs
+            next_buf[t] = nxt
+            act_buf[t] = action
+            rew_buf[t] = rew
+            done_buf[t] = float(term)  # truncation bootstraps
+            self._episode_return += rew
+            if term or trunc:
+                self._completed_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                obs = env.reset(seed=int(self._rng.randint(0, 2 ** 31)))
+            else:
+                obs = nxt
+        self._obs = obs
+        return {
+            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "next_obs": next_buf, "dones": done_buf,
+            "episode_returns": np.asarray(self._completed_returns,
+                                          np.float32),
+        }

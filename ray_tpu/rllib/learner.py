@@ -297,3 +297,125 @@ class DQNLearner:
             self.params, self._opt_state, self.target_params, dev)
         td_abs = np.asarray(aux.pop("td_abs"))
         return {k: float(v) for k, v in aux.items()}, td_abs
+
+
+class SACLearner:
+    """Soft Actor-Critic for continuous control (reference:
+    rllib/algorithms/sac/sac.py + torch learner losses; Haarnoja et al.
+    2018): squashed-Gaussian policy, twin Q critics with a polyak-
+    averaged target pair, and automatic entropy-temperature tuning
+    against target_entropy = -action_size. One jitted update performs
+    critic + actor + alpha steps and the soft target sync."""
+
+    def __init__(self, obs_size: int, action_size: int, *,
+                 action_scale: float = 1.0,
+                 hidden: Tuple[int, ...] = (64, 64), lr: float = 3e-4,
+                 gamma: float = 0.99, tau: float = 0.005,
+                 init_alpha: float = 0.1, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        key = jax.random.PRNGKey(seed)
+        kp, k1, k2 = jax.random.split(key, 3)
+        self.params = {
+            "pi": _mlp_init(kp, (obs_size, *hidden, 2 * action_size)),
+            "q1": _mlp_init(k1, (obs_size + action_size, *hidden, 1)),
+            "q2": _mlp_init(k2, (obs_size + action_size, *hidden, 1)),
+            "log_alpha": jnp.asarray(float(np.log(init_alpha))),
+        }
+        self.target_params = {
+            "q1": jax.tree.map(lambda x: x, self.params["q1"]),
+            "q2": jax.tree.map(lambda x: x, self.params["q2"]),
+        }
+        self.action_scale = float(action_scale)
+        target_entropy = -float(action_size)
+        self._opt = optax.adam(lr)
+        self._opt_state = self._opt.init(self.params)
+        LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
+
+        def pi_sample(pi_params, obs, key):
+            out = _mlp_apply(pi_params, obs)
+            mean, log_std = jnp.split(out, 2, axis=-1)
+            log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+            std = jnp.exp(log_std)
+            eps = jax.random.normal(key, mean.shape)
+            pre = mean + std * eps
+            act = jnp.tanh(pre)
+            # log-prob with tanh change-of-variables (SAC appendix C).
+            logp = (-0.5 * (eps ** 2 + 2 * log_std
+                            + jnp.log(2 * jnp.pi))).sum(-1)
+            logp -= jnp.log(1 - act ** 2 + 1e-6).sum(-1)
+            return act * self.action_scale, logp
+
+        def q_apply(q_params, obs, act):
+            return _mlp_apply(q_params,
+                              jnp.concatenate([obs, act], -1))[..., 0]
+
+        def losses(params, target, batch, key):
+            ka, kb = jax.random.split(key)
+            alpha = jnp.exp(params["log_alpha"])
+            # ---- critic ----
+            a_next, logp_next = pi_sample(params["pi"],
+                                          batch["next_obs"], ka)
+            q_next = jnp.minimum(
+                q_apply(target["q1"], batch["next_obs"], a_next),
+                q_apply(target["q2"], batch["next_obs"], a_next))
+            backup = batch["rewards"] + gamma * (1 - batch["dones"]) * (
+                q_next - jax.lax.stop_gradient(alpha) * logp_next)
+            backup = jax.lax.stop_gradient(backup)
+            q1 = q_apply(params["q1"], batch["obs"], batch["actions"])
+            q2 = q_apply(params["q2"], batch["obs"], batch["actions"])
+            critic_loss = jnp.mean((q1 - backup) ** 2
+                                   + (q2 - backup) ** 2)
+            # ---- actor ----
+            a_new, logp_new = pi_sample(params["pi"], batch["obs"], kb)
+            q_new = jnp.minimum(
+                q_apply(jax.lax.stop_gradient(params["q1"]),
+                        batch["obs"], a_new),
+                q_apply(jax.lax.stop_gradient(params["q2"]),
+                        batch["obs"], a_new))
+            actor_loss = jnp.mean(
+                jax.lax.stop_gradient(alpha) * logp_new - q_new)
+            # ---- temperature ----
+            alpha_loss = -jnp.mean(
+                params["log_alpha"]
+                * jax.lax.stop_gradient(logp_new + target_entropy))
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {"critic_loss": critic_loss,
+                           "actor_loss": actor_loss,
+                           "alpha": alpha,
+                           "entropy": -jnp.mean(logp_new)}
+
+        @jax.jit
+        def update(params, opt_state, target, batch, key):
+            (loss, aux), grads = jax.value_and_grad(
+                losses, has_aux=True)(params, target, batch, key)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target = jax.tree.map(lambda t, p: (1 - tau) * t + tau * p,
+                                  target, {"q1": params["q1"],
+                                           "q2": params["q2"]})
+            aux["loss"] = loss
+            return params, opt_state, target, aux
+
+        self._update_fn = update
+        self._key = jax.random.PRNGKey(seed + 17)
+
+    def get_weights(self) -> Any:
+        import jax
+        return jax.tree.map(np.asarray,
+                            {"pi": self.params["pi"],
+                             "action_scale": self.action_scale})
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        self._key, sub = jax.random.split(self._key)
+        dev = {k: jnp.asarray(v) for k, v in batch.items()
+               if k != "indices"}
+        self.params, self._opt_state, self.target_params, aux = \
+            self._update_fn(self.params, self._opt_state,
+                            self.target_params, dev, sub)
+        return {k: float(v) for k, v in aux.items()}
