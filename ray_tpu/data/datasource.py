@@ -39,16 +39,17 @@ def _expand_paths(paths) -> List[str]:
                 import fsspec
                 fs, _ = fsspec.core.url_to_fs(p)
                 out.extend(f"{proto}://{m}" for m in sorted(fs.glob(p)))
-            else:
+            elif p.endswith("/"):
+                # Explicit remote directory prefix (s3://bucket/table/):
+                # expand like the local os.walk branch. Only the trailing
+                # slash triggers the remote listing — probing isdir on
+                # every plain file URL would cost one network round-trip
+                # per path at dataset-construction time.
                 import fsspec
                 fs, root = fsspec.core.url_to_fs(p)
-                if fs.isdir(root):
-                    # Remote directory prefix: expand like the local
-                    # os.walk branch (s3://bucket/table/ reads its files).
-                    out.extend(f"{proto}://{m}"
-                               for m in sorted(fs.find(root)))
-                else:
-                    out.append(p)
+                out.extend(f"{proto}://{m}" for m in sorted(fs.find(root)))
+            else:
+                out.append(p)
         elif os.path.isdir(p):
             for root, _, files in os.walk(p):
                 out.extend(os.path.join(root, f) for f in sorted(files)

@@ -184,6 +184,7 @@ class EnvRunner:
         `next_obs` at a boundary is the final pre-reset obs."""
         pi = self._weights["pi"]
         scale = float(self._weights.get("action_scale", 1.0))
+        shift = float(self._weights.get("action_shift", 0.0))
         env = self._env
         asize = env.action_size
         obs_buf = np.zeros((num_steps, env.observation_size), np.float32)
@@ -197,8 +198,9 @@ class EnvRunner:
         for t in range(num_steps):
             out = _np_forward(pi, obs[None, :])[0]
             mean, log_std = out[:asize], np.clip(out[asize:], -5.0, 2.0)
-            action = np.tanh(mean + np.exp(log_std)
-                             * self._rng.standard_normal(asize)) * scale
+            action = shift + np.tanh(
+                mean + np.exp(log_std)
+                * self._rng.standard_normal(asize)) * scale
             nxt, rew, term, trunc, _ = env.step(action.astype(np.float32))
             obs_buf[t] = obs
             next_buf[t] = nxt

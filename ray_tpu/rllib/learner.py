@@ -308,7 +308,7 @@ class SACLearner:
     critic + actor + alpha steps and the soft target sync."""
 
     def __init__(self, obs_size: int, action_size: int, *,
-                 action_scale: float = 1.0,
+                 action_scale: float = 1.0, action_shift: float = 0.0,
                  hidden: Tuple[int, ...] = (64, 64), lr: float = 3e-4,
                  gamma: float = 0.99, tau: float = 0.005,
                  init_alpha: float = 0.1, seed: int = 0):
@@ -328,7 +328,11 @@ class SACLearner:
             "q1": jax.tree.map(lambda x: x, self.params["q1"]),
             "q2": jax.tree.map(lambda x: x, self.params["q2"]),
         }
+        # Affine squash: action = shift + scale * tanh(.), covering
+        # asymmetric [low, high] boxes (scale=(high-low)/2,
+        # shift=(high+low)/2).
         self.action_scale = float(action_scale)
+        self.action_shift = float(action_shift)
         target_entropy = -float(action_size)
         self._opt = optax.adam(lr)
         self._opt_state = self._opt.init(self.params)
@@ -346,7 +350,7 @@ class SACLearner:
             logp = (-0.5 * (eps ** 2 + 2 * log_std
                             + jnp.log(2 * jnp.pi))).sum(-1)
             logp -= jnp.log(1 - act ** 2 + 1e-6).sum(-1)
-            return act * self.action_scale, logp
+            return self.action_shift + act * self.action_scale, logp
 
         def q_apply(q_params, obs, act):
             return _mlp_apply(q_params,
@@ -406,7 +410,8 @@ class SACLearner:
         import jax
         return jax.tree.map(np.asarray,
                             {"pi": self.params["pi"],
-                             "action_scale": self.action_scale})
+                             "action_scale": self.action_scale,
+                             "action_shift": self.action_shift})
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         import jax

@@ -73,7 +73,10 @@ class SAC:
         probe = config.env_maker()
         self._learner = SACLearner(
             probe.observation_size, probe.action_size,
-            action_scale=float(probe.action_high),
+            action_scale=(float(probe.action_high)
+                          - float(probe.action_low)) / 2.0,
+            action_shift=(float(probe.action_high)
+                          + float(probe.action_low)) / 2.0,
             hidden=tuple(config.hidden), lr=config.lr,
             gamma=config.gamma, tau=config.tau,
             init_alpha=config.init_alpha, seed=config.seed)

@@ -19,8 +19,8 @@ concurrency cap; ASHA stops under-performers at rungs.
     best = grid.get_best_result()
 """
 
-from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
-                                     PBTScheduler)
+from ray_tpu.tune.schedulers import (ASHAScheduler, BOHBScheduler,
+                                     FIFOScheduler, PBTScheduler)
 from ray_tpu.tune.search import (BasicVariantGenerator, Searcher, choice,
                                  grid_search, loguniform, randint, uniform)
 from ray_tpu.tune.tpe import TPESearcher
@@ -28,7 +28,8 @@ from ray_tpu.tune.trial import get_checkpoint, report
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
 
 __all__ = [
-    "ASHAScheduler", "BasicVariantGenerator", "FIFOScheduler",
+    "ASHAScheduler", "BOHBScheduler", "BasicVariantGenerator",
+    "FIFOScheduler",
     "PBTScheduler", "ResultGrid", "Searcher", "TPESearcher", "TrialResult",
     "TuneConfig", "Tuner", "choice", "get_checkpoint", "grid_search",
     "loguniform", "randint", "report", "uniform",

@@ -15,6 +15,22 @@ CONTINUE = "CONTINUE"
 STOP = "STOP"
 
 
+def _rung_decision(vals: Dict[str, float], metric_value: float,
+                   rf: int, mode: str) -> str:
+    """Successive-halving cut at one rung: survive only in the top
+    1/rf of the values recorded there (optimistic until rf peers
+    exist). Shared by ASHA and the BOHB brackets."""
+    if len(vals) < rf:
+        return CONTINUE
+    ranked = sorted(vals.values())
+    if mode == "max":
+        ranked = ranked[::-1]
+    cutoff = ranked[max(0, len(vals) // rf - 1)]
+    good = metric_value <= cutoff if mode == "min" \
+        else metric_value >= cutoff
+    return CONTINUE if good else STOP
+
+
 class FIFOScheduler:
     def on_result(self, trial_id: str, iteration: int,
                   metric_value: float) -> str:
@@ -60,15 +76,8 @@ class ASHAScheduler:
             if iteration == rung:
                 vals = self._recorded[rung]
                 vals[trial_id] = metric_value
-                if len(vals) < self.rf:
-                    return CONTINUE  # not enough peers yet: optimistic
-                ranked = sorted(vals.values())
-                if self.mode == "max":
-                    ranked = ranked[::-1]
-                cutoff = ranked[max(0, len(vals) // self.rf - 1)]
-                good = metric_value <= cutoff if self.mode == "min" \
-                    else metric_value >= cutoff
-                return CONTINUE if good else STOP
+                return _rung_decision(vals, metric_value, self.rf,
+                                      self.mode)
         return CONTINUE
 
 
@@ -163,3 +172,83 @@ class PBTScheduler:
         new_config = self._mutate(self._configs.get(source, {}))
         self._configs[trial_id] = new_config
         return (EXPLOIT, source, new_config)
+
+
+class BOHBScheduler:
+    """HyperBand bracketing for BOHB (reference: python/ray/tune/
+    schedulers/hb_bohb.py HyperBandForBOHB + Falkner et al. 2018): pair
+    this scheduler with TPESearcher as the search_alg and you have BOHB —
+    model-based proposals + multi-bracket successive halving. Each trial
+    is assigned (round-robin over the HyperBand bracket allocation) to a
+    bracket whose rung ladder starts at grace_period * rf^s; within a
+    bracket the asynchronous successive-halving rule applies, so
+    aggressive brackets kill weak trials with tiny budgets while the
+    conservative bracket lets slow starters mature."""
+
+    def __init__(self, *, max_t: int = 81, grace_period: int = 1,
+                 reduction_factor: int = 3,
+                 metric: Optional[str] = None, mode: str = "min"):
+        assert mode in ("min", "max")
+        self.max_t = max_t
+        self.rf = reduction_factor
+        self.metric = metric
+        self.mode = mode
+        # Brackets s = s_max .. 0; bracket s's first rung is
+        # grace * rf^s (HyperBand's r_s = R / rf^s budget schedule,
+        # expressed as rung milestones).
+        s_max = 0
+        t = grace_period
+        while t * reduction_factor < max_t:
+            t *= reduction_factor
+            s_max += 1
+        # Bracket i (aggressive-first): rung ladder starting at
+        # grace * rf^i — bracket 0 halves from the smallest budget,
+        # bracket s_max runs near-full budget before any cut.
+        self._brackets: List[List[int]] = []
+        for i in range(s_max + 1):
+            rungs = []
+            r = grace_period * (reduction_factor ** i)
+            while r < max_t:
+                rungs.append(r)
+                r *= reduction_factor
+            self._brackets.append(rungs or [grace_period])
+        # HyperBand allocates ~rf^s / (s+1) trials to the bracket doing
+        # s rounds of halving (more to aggressive brackets); bracket i
+        # halves s = s_max - i times.
+        weights = [max(1, round((reduction_factor ** (s_max - i))
+                                / (s_max - i + 1)))
+                   for i in range(s_max + 1)]
+        self._cycle: List[int] = []
+        for idx, w in enumerate(weights):
+            self._cycle.extend([idx] * w)
+        self._next = 0
+        self._bracket_of: Dict[str, int] = {}
+        # (bracket, rung) -> {trial_id: metric}
+        self._recorded: Dict[tuple, Dict[str, float]] = {}
+
+    def track(self, trial_id: str, config: dict) -> None:
+        if trial_id in self._bracket_of:
+            return
+        self._bracket_of[trial_id] = self._cycle[self._next
+                                                 % len(self._cycle)]
+        self._next += 1
+
+    def on_trial_restore(self, trial_id: str) -> None:
+        for vals in self._recorded.values():
+            vals.pop(trial_id, None)
+
+    def on_result(self, trial_id: str, iteration: int,
+                  metric_value: float) -> str:
+        if iteration >= self.max_t:
+            return STOP
+        b = self._bracket_of.get(trial_id)
+        if b is None:  # untracked (restored mid-run): conservative
+            b = len(self._brackets) - 1
+            self._bracket_of[trial_id] = b
+        for rung in reversed(self._brackets[b]):
+            if iteration == rung:
+                vals = self._recorded.setdefault((b, rung), {})
+                vals[trial_id] = metric_value
+                return _rung_decision(vals, metric_value, self.rf,
+                                      self.mode)
+        return CONTINUE

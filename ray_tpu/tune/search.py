@@ -119,7 +119,11 @@ class Searcher:
 
     def on_trial_complete(self, trial_id: str,
                           result: Optional[Dict[str, Any]] = None,
-                          error: bool = False) -> None:
+                          error: bool = False,
+                          budget: int = 0) -> None:
+        """`budget`: the iteration count the trial reached — multi-
+        fidelity searchers (BOHB's TPE) compare observations only
+        within a budget level."""
         pass
 
     def on_trial_restore(self, trial_id: str,

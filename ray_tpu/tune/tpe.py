@@ -62,7 +62,8 @@ class TPESearcher(Searcher):
 
     def on_trial_complete(self, trial_id: str,
                           result: Optional[Dict[str, Any]] = None,
-                          error: bool = False) -> None:
+                          error: bool = False,
+                          budget: int = 0) -> None:
         cfg = self._pending.pop(trial_id, None)
         if cfg is None or error or not result \
                 or self.metric not in result:
@@ -70,11 +71,26 @@ class TPESearcher(Searcher):
         score = float(result[self.metric])
         if self.mode == "max":
             score = -score
-        self._obs.append((cfg, score))
+        self._obs.append((cfg, score, int(budget)))
 
     # -- TPE core --------------------------------------------------------
     def _split(self) -> Tuple[list, list]:
-        ranked = sorted(self._obs, key=lambda o: o[1])
+        # Multi-fidelity (BOHB, Falkner et al. 2018): model the HIGHEST
+        # budget with enough observations — scores from different rungs
+        # are not comparable (an early-stopped trial's loss carries the
+        # low-fidelity bias). With a single budget level (no early
+        # stopping) this is all observations, plain TPE.
+        n_min = max(2, len([d for d in self.space.values()
+                            if isinstance(d, Domain)]) + 1)
+        by_budget: Dict[int, list] = {}
+        for o in self._obs:
+            by_budget.setdefault(o[2], []).append(o)
+        pool = self._obs
+        for b in sorted(by_budget, reverse=True):
+            if len(by_budget[b]) >= n_min:
+                pool = by_budget[b]
+                break
+        ranked = sorted(pool, key=lambda o: o[1])
         n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
         return ranked[:n_good], ranked[n_good:]
 

@@ -29,6 +29,8 @@ class _TuneSession:
         self.finished = False
         self.error: Optional[str] = None
         self.checkpoint: Optional[bytes] = None  # latest saved state
+        self.ckpt_version = 0                    # bumps on every save
+        self.ckpt_iteration = 0                  # iteration it captured
         self.restored = restored                 # state to resume from
 
 
@@ -48,6 +50,8 @@ def report(metrics: Dict[str, Any], *,
         _session.reported.append(dict(metrics))
         if checkpoint is not None:
             _session.checkpoint = cloudpickle.dumps(checkpoint)
+            _session.ckpt_iteration = _session.iteration
+            _session.ckpt_version += 1
         if _session.stop_requested:
             raise _TrialStopped()
 
@@ -91,18 +95,28 @@ class TrialRunner:
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="trial")
         self._thread.start()
+        self._ckpt_sent = 0
 
     def poll(self) -> dict:
         s = self._session
         with s.lock:
             reported = s.reported
             s.reported = []
-            return {
+            out = {
                 "reported": reported,
                 "iteration": s.iteration,
                 "finished": s.finished,
                 "error": s.error,
             }
+            # Ship NEW checkpoints to the controller so a trial can be
+            # rescheduled from its latest state after a node loss
+            # (reference: trial checkpoints persist to storage; here the
+            # controller is the storage).
+            if s.ckpt_version > self._ckpt_sent:
+                out["checkpoint"] = s.checkpoint
+                out["checkpoint_iteration"] = s.ckpt_iteration
+                self._ckpt_sent = s.ckpt_version
+            return out
 
     def stop_trial(self) -> None:
         with self._session.lock:

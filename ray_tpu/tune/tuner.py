@@ -30,10 +30,14 @@ class TuneConfig:
     mode: str = "min"                 # or "max"
     num_samples: int = 1
     max_concurrent_trials: int = 4
-    scheduler: Any = None             # FIFO | ASHA | PBT
+    scheduler: Any = None             # FIFO | ASHA | BOHB | PBT
     search_alg: Any = None            # Searcher (suggest/on_trial_complete)
     seed: Optional[int] = None
     resources_per_trial: Dict[str, float] = field(default_factory=dict)
+    # In-run trial fault tolerance (reference: FailureConfig.max_failures):
+    # a trial whose actor dies (node loss) is rescheduled from its latest
+    # controller-held checkpoint up to this many times.
+    max_failures: int = 0
 
 
 @dataclass
@@ -225,6 +229,21 @@ class Tuner:
 
         running: Dict[str, Any] = {}   # trial_id -> actor handle
         stopping: set = set()
+        # Controller-held latest (checkpoint_blob, iteration) + failure
+        # count per trial (the reschedule-with-checkpoint FT path).
+        ckpts: Dict[str, tuple] = {}
+        failures: Dict[str, int] = {}
+        # Probe once whether the searcher accepts the budget kwarg (a
+        # live-call TypeError fallback would double-invoke a searcher
+        # whose BODY raised TypeError).
+        searcher_takes_budget = False
+        if cfg.search_alg is not None:
+            import inspect
+            try:
+                searcher_takes_budget = "budget" in inspect.signature(
+                    cfg.search_alg.on_trial_complete).parameters
+            except (TypeError, ValueError):
+                pass
         actor_cls = ray_tpu.remote(TrialRunner)
         opts: Dict[str, Any] = {}
         if cfg.resources_per_trial:
@@ -260,10 +279,51 @@ class Tuner:
                 try:
                     p = ray_tpu.get(actor.poll.remote(), timeout=60)
                 except Exception as e:
+                    failures[tid] = failures.get(tid, 0) + 1
+                    if tid in stopping:
+                        # The scheduler already cut this trial; losing
+                        # its actor finalizes the stop instead of
+                        # resurrecting a full-budget run.
+                        t.status = STOPPED
+                        done.append(tid)
+                        continue
+                    if failures[tid] <= cfg.max_failures:
+                        # Node/actor loss mid-trial: reschedule from the
+                        # latest controller-held checkpoint (fresh start
+                        # if it never checkpointed). Reference:
+                        # tune_controller restoring FAILED trials under
+                        # FailureConfig. Kill the old actor first — a
+                        # poll TIMEOUT (not death) must never leave two
+                        # copies of the trial running.
+                        try:
+                            ray_tpu.kill(actor)
+                        except Exception:
+                            pass
+                        ck = ckpts.get(tid)
+                        start_it = ck[1] if ck else 0
+                        logger.warning(
+                            "trial %s lost (%r): rescheduling "
+                            "(failure %d/%d, checkpoint_iter=%s)", tid, e,
+                            failures[tid], cfg.max_failures, start_it)
+                        if hasattr(scheduler, "on_trial_restore") \
+                                and ck is None:
+                            scheduler.on_trial_restore(tid)
+                        # Iteration numbering restarts AT the checkpoint
+                        # so scheduler rungs stay aligned.
+                        t.iterations = start_it
+                        running[tid] = actor_cls.remote(
+                            self._fn_blob, t.config,
+                            restored=ck[0] if ck else None,
+                            start_iteration=start_it)
+                        continue
                     t.status = ERROR
                     t.error = f"trial actor died: {e!r}"
                     done.append(tid)
                     continue
+                if p.get("checkpoint") is not None:
+                    ckpts[tid] = (p["checkpoint"],
+                                  p.get("checkpoint_iteration",
+                                        p["iteration"]))
                 for m in p["reported"]:
                     t.metrics_history.append(m)
                     t.metrics = m
@@ -320,8 +380,11 @@ class Tuner:
                 # suggestions learn from them (reference: SearchGenerator
                 # on_trial_complete).
                 if cfg.search_alg is not None:
+                    kw = {"budget": t.iterations} \
+                        if searcher_takes_budget else {}
                     cfg.search_alg.on_trial_complete(
-                        tid, t.metrics or None, error=t.status == ERROR)
+                        tid, t.metrics or None,
+                        error=t.status == ERROR, **kw)
             if done:
                 # One snapshot per poll round (it serializes the whole
                 # trial table + searcher state).
