@@ -571,7 +571,8 @@ class Controller:
                         max_restarts=(-1 if entry.max_restarts == -1 else
                                       max(0, entry.max_restarts
                                           - entry.restarts_used)),
-                        pip=entry.runtime_env.get("pip"))
+                        pip=entry.runtime_env.get("pip"),
+                        image_uri=entry.runtime_env.get("image_uri"))
                     entry.addr = tuple(reply["addr"])
                     entry.node_id = node.node_id
                     entry.state = ActorState.ALIVE

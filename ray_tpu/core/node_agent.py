@@ -127,6 +127,7 @@ class WorkerProc:
         self.spawned_at: float = time.monotonic()
         self.max_restarts: int = 0  # for dedicated actor workers
         self.cgroup_scope = None    # WorkerCgroup for isolated workers
+        self.python_exe: Optional[str] = None  # venv python (GC marker)
 
 
 class NodeAgent:
@@ -518,6 +519,54 @@ class NodeAgent:
     # ------------------------------------------------------------------
     # worker pool (reference: src/ray/raylet/worker_pool.cc)
     # ------------------------------------------------------------------
+    def _gc_venv_cache(self) -> List[str]:
+        """LRU-evict cached venvs past the size cap (reference:
+        runtime_env cache GC — the reference deletes unused runtime-env
+        cache entries by cache size; ours keys on READY mtime, which
+        _ensure_pip_env touches on every reuse). Venvs whose python a
+        LIVE worker runs are never evicted. Returns evicted dirs."""
+        cap = GlobalConfig.runtime_env_cache_bytes
+        root = os.path.join(self.session_dir, "venvs")
+        if cap <= 0 or not os.path.isdir(root):
+            return []
+        in_use = set()
+        # Workers still between spawn and registration count too — their
+        # interpreter may be starting from the venv right now.
+        import itertools
+        for w in itertools.chain(self.workers.values(),
+                                 self._pending_registration.values()):
+            exe = getattr(w, "python_exe", None)
+            if exe and exe.startswith(root):
+                # <root>/<key>/bin/python -> <root>/<key>
+                in_use.add(os.path.dirname(os.path.dirname(exe)))
+        entries = []
+        total = 0
+        for name in os.listdir(root):
+            d = os.path.join(root, name)
+            ready = os.path.join(d, "READY")
+            if not os.path.isdir(d) or not os.path.exists(ready):
+                continue
+            try:
+                size = sum(os.path.getsize(os.path.join(r, f))
+                           for r, _, fs in os.walk(d) for f in fs)
+                mtime = os.path.getmtime(ready)
+            except OSError:
+                continue  # concurrently removed
+            entries.append((mtime, d, size))
+            total += size
+        evicted = []
+        for _, d, size in sorted(entries):  # oldest READY first
+            if total <= cap:
+                break
+            if d in in_use:
+                continue
+            shutil.rmtree(d, ignore_errors=True)
+            total -= size
+            evicted.append(d)
+            logger.info("evicted cached runtime env %s (%d bytes)",
+                        os.path.basename(d), size)
+        return evicted
+
     async def _ensure_pip_env(self, pip: List[str]) -> str:
         """Create (or reuse) a per-content venv with the requested
         packages (reference: python/ray/_private/runtime_env/pip.py —
@@ -532,12 +581,19 @@ class NodeAgent:
         python = os.path.join(venv_dir, "bin", "python")
         ready = os.path.join(venv_dir, "READY")
         if os.path.exists(ready):
+            os.utime(ready)  # LRU touch: reuse refreshes eviction order
             return python
         lock = self._venv_locks.setdefault(key, asyncio.Lock())
         async with lock:
             if os.path.exists(ready):
+                os.utime(ready)
                 return python
             loop = asyncio.get_running_loop()
+            # One GC at a time: two concurrent sweeps could rmtree a dir
+            # the other is mid-os.walk on.
+            gc_lock = self._venv_locks.setdefault("__gc__", asyncio.Lock())
+            async with gc_lock:
+                await loop.run_in_executor(None, self._gc_venv_cache)
 
             def _build():
                 import glob
@@ -576,10 +632,46 @@ class NodeAgent:
             await loop.run_in_executor(None, _build)
             return python
 
+    def _container_argv(self, image_uri: str, env: Dict[str, str],
+                        user_env: Optional[Dict[str, str]] = None,
+                        memory_bytes: Optional[int] = None) -> List[str]:
+        """Worker argv for an image_uri runtime env (reference:
+        _private/runtime_env/image_uri.py — the worker process runs
+        inside a container). The command is a TEMPLATE from config
+        (default podman; swap for docker or a test stub), with
+        {session_dir}/{image} substitution, {env_flags} expanding to
+        --env k=v (runtime plumbing vars PLUS every user env_vars key —
+        user vars must reach the container even without a recognized
+        prefix), and {memory_flags} expanding to the container runtime's
+        memory cap (host cgroups can't reach the containerized
+        workload)."""
+        import json as _json
+        template = _json.loads(GlobalConfig.container_run_template)
+        keep_prefixes = ("RAY_TPU_", "TPU_", "JAX_", "XLA_", "PYTHON")
+        forward = {k: v for k, v in env.items()
+                   if k.startswith(keep_prefixes)}
+        for k, v in (user_env or {}).items():
+            if v is not None:  # None-unset: simply don't forward
+                forward[str(k)] = str(v)
+        env_flags = [f"--env={k}={v}" for k, v in sorted(forward.items())]
+        mem_flags = ([f"--memory={int(memory_bytes)}"]
+                     if memory_bytes else [])
+        argv: List[str] = []
+        for part in template:
+            if part == "{env_flags}":
+                argv.extend(env_flags)
+            elif part == "{memory_flags}":
+                argv.extend(mem_flags)
+            else:
+                argv.append(part.replace("{image}", image_uri)
+                            .replace("{session_dir}", self.session_dir))
+        return argv
+
     def _spawn_worker(self, extra_env: Optional[Dict[str, str]] = None,
                       python_exe: Optional[str] = None,
                       memory_bytes: Optional[int] = None,
-                      cpus: Optional[float] = None) -> WorkerProc:
+                      cpus: Optional[float] = None,
+                      image_uri: Optional[str] = None) -> WorkerProc:
         env = dict(os.environ)
         env["RAY_TPU_AGENT_ADDR"] = f"{self.host}:{self.port}"
         env["RAY_TPU_CONTROLLER_ADDR"] = \
@@ -612,6 +704,14 @@ class NodeAgent:
                                            rlimit_preexec)
         scope = None
         preexec = None
+        container_mem = None
+        if image_uri:
+            # Host cgroups/rlimits would bind the podman CLIENT, not the
+            # containerized workload — the container runtime enforces the
+            # memory cap instead ({memory_flags} in the template).
+            container_mem = memory_bytes
+            memory_bytes = None
+            cpus = None
         if memory_bytes or cpus:
             if GlobalConfig.cgroup_isolation:
                 scope = create_worker_cgroup(
@@ -623,10 +723,16 @@ class NodeAgent:
             if scope is None and memory_bytes \
                     and GlobalConfig.worker_rlimit_memory:
                 preexec = rlimit_preexec(int(memory_bytes))
+        if image_uri:
+            argv = self._container_argv(image_uri, env,
+                                        user_env=extra_env,
+                                        memory_bytes=container_mem)
+        else:
+            argv = [python_exe or sys.executable, "-m",
+                    "ray_tpu.core.worker_main"]
         try:
             proc = subprocess.Popen(
-                [python_exe or sys.executable, "-m",
-                 "ray_tpu.core.worker_main"],
+                argv,
                 env=env, cwd=os.getcwd(),
                 stdout=subprocess.PIPE if capture else None,
                 stderr=subprocess.STDOUT if capture else None,
@@ -641,6 +747,7 @@ class NodeAgent:
             scope.add_pid(proc.pid)
         w = WorkerProc(proc, b"")
         w.cgroup_scope = scope
+        w.python_exe = python_exe  # venv-GC in-use marker
         self._pending_registration[proc.pid] = w
         if capture:
             self._start_log_pump(proc)
@@ -932,7 +1039,8 @@ class NodeAgent:
                           bundle_index: int,
                           env_vars: Optional[Dict[str, str]] = None,
                           max_restarts: int = 0,
-                          pip: Optional[List[str]] = None) -> dict:
+                          pip: Optional[List[str]] = None,
+                          image_uri: Optional[str] = None) -> dict:
         tpu_req = float(resources.get("TPU", 0))
         if tpu_req != int(tpu_req):
             # Chips are whole devices: fractional TPU would desynchronize
@@ -964,12 +1072,18 @@ class NodeAgent:
             # venv's python (reference: runtime_env/pip.py). INSIDE the
             # try: a failed venv build must roll back the resources and
             # chips reserved above, like any other startup failure.
+            if pip and image_uri:
+                raise ValueError(
+                    "runtime_env cannot combine pip with image_uri — the "
+                    "container uses the image's interpreter; bake the "
+                    "packages into the image")
             python_exe = await self._ensure_pip_env(pip) if pip else None
             w = self._spawn_worker(  # dedicated, never pooled
                 env_vars, python_exe,
                 memory_bytes=int(resources["memory"])
                 if resources.get("memory") else None,
-                cpus=float(resources.get("CPU", 0)) or None)
+                cpus=float(resources.get("CPU", 0)) or None,
+                image_uri=image_uri)
             await asyncio.wait_for(w.ready.wait(),
                                    GlobalConfig.worker_register_timeout_s)
             w.dedicated_actor = actor_id

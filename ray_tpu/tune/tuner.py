@@ -360,7 +360,11 @@ class Tuner:
                             actor_cls, running, by_id, tid, source_tid,
                             new_config)
                         if replaced is not None:
-                            running[tid] = replaced
+                            running[tid] = replaced[0]
+                            # The FT reschedule path must restore the
+                            # EXPLOITED state, not the trial's stale
+                            # pre-exploit checkpoint.
+                            ckpts[tid] = (replaced[1], t.iterations)
                 if p["finished"]:
                     if p["error"]:
                         t.status = ERROR
@@ -400,7 +404,8 @@ class Tuner:
                  source_tid: str, new_config: dict):
         """PBT exploit: clone the source's checkpoint into a replacement
         actor for `tid` running `new_config` (reference: pbt.py
-        _exploit — checkpoint copy + explore)."""
+        _exploit — checkpoint copy + explore). Returns (new_actor,
+        checkpoint_blob) or None."""
         source = running.get(source_tid)
         if source is None:
             return None  # source finished: skip this round
@@ -420,6 +425,6 @@ class Tuner:
         t.config = dict(new_config)
         logger.info("PBT exploit: %s <- %s (config %s)", tid, source_tid,
                     new_config)
-        return actor_cls.remote(self._fn_blob, dict(new_config),
-                                restored=ckpt,
-                                start_iteration=t.iterations)
+        return (actor_cls.remote(self._fn_blob, dict(new_config),
+                                 restored=ckpt,
+                                 start_iteration=t.iterations), ckpt)

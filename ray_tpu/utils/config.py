@@ -65,6 +65,8 @@ _flag("task_retry_delay_ms", int, 100, "Delay before retrying a failed task.")
 _flag("object_store_memory_bytes", int, 2 * 1024**3, "Default shm arena size per node.")
 _flag("store_fastpath", bool, True, "Native store sidecar: workers do put/get over a C unix-socket path (no event loop); falls back to agent RPC when off or unavailable.")
 _flag("data_memory_budget_bytes", int, 0, "Streaming Data executor byte budget for in-flight blocks; 0 = auto (object store / 4).")
+_flag("container_run_template", str, '["podman", "run", "--rm", "--network=host", "-v", "{session_dir}:{session_dir}", "-v", "/dev/shm:/dev/shm", "{memory_flags}", "{env_flags}", "{image}", "python3", "-m", "ray_tpu.core.worker_main"]', "JSON argv template for image_uri runtime envs ({image}/{session_dir}/{env_flags}/{memory_flags} placeholders); swap for docker or a test stub.")
+_flag("runtime_env_cache_bytes", int, 10 * 1024**3, "LRU size cap for cached runtime-env venvs per session; oldest unused evict first.")
 _flag("object_store_min_spill_bytes", int, 100 * 1024**2, "Batch spills until this many bytes.")
 _flag("max_direct_call_object_size", int, 100 * 1024, "Inline results smaller than this in-process.")
 _flag("object_transfer_chunk_bytes", int, 5 * 1024**2, "Chunk size for node-to-node object transfer.")

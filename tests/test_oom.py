@@ -50,3 +50,68 @@ def test_oom_kills_and_task_retries(tmp_path):
         c.shutdown()
         GlobalConfig._overrides.clear()
         GlobalConfig._cache.clear()
+
+
+def _fake_worker(*, lease=None, actor=None, max_restarts=0, spawned_at=0.0,
+                 external=False):
+    import subprocess
+    import types
+
+    w = types.SimpleNamespace(
+        current_lease=lease, dedicated_actor=actor,
+        max_restarts=max_restarts, spawned_at=spawned_at)
+    if external:
+        w.proc = object()  # not a Popen: agent must never kill it
+    else:
+        w.proc = subprocess.Popen.__new__(subprocess.Popen)
+    return w
+
+
+def _agent_with(workers):
+    from ray_tpu.core.node_agent import NodeAgent
+
+    agent = NodeAgent.__new__(NodeAgent)
+    agent.workers = {bytes([i]): w for i, w in enumerate(workers)}
+    return agent
+
+
+def test_oom_victim_prefers_newest_leased_task_worker():
+    """Retriable-FIFO (reference: worker_killing_policy_retriable_fifo.cc):
+    among leased task workers the NEWEST dies first (its retry loses the
+    least progress), and task workers die before any actor."""
+    old_task = _fake_worker(lease=b"l1", spawned_at=1.0)
+    new_task = _fake_worker(lease=b"l2", spawned_at=9.0)
+    actor = _fake_worker(actor=b"a", max_restarts=5, spawned_at=99.0)
+    agent = _agent_with([old_task, actor, new_task])
+    victim, retriable = agent._pick_oom_victim()
+    assert victim is new_task and retriable
+
+
+def test_oom_victim_actor_fallback_requires_restart_budget():
+    """No leased task workers: a dedicated actor is the fallback, but
+    ONLY with restart budget (killing a max_restarts=0 actor fails it
+    permanently — reference: group-by-owner policy spares
+    non-retriable work)."""
+    frozen = _fake_worker(actor=b"a0", max_restarts=0, spawned_at=5.0)
+    restartable_old = _fake_worker(actor=b"a1", max_restarts=1,
+                                   spawned_at=1.0)
+    restartable_new = _fake_worker(actor=b"a2", max_restarts=-1,
+                                   spawned_at=9.0)
+    agent = _agent_with([frozen, restartable_old, restartable_new])
+    victim, retriable = agent._pick_oom_victim()
+    assert victim is restartable_new and not retriable
+
+    # Only a non-restartable actor left: nobody dies.
+    agent = _agent_with([frozen])
+    victim, _ = agent._pick_oom_victim()
+    assert victim is None
+
+
+def test_oom_victim_never_external_or_idle():
+    """External (non-Popen) processes are never victims; neither are
+    idle pooled workers (no lease, no actor)."""
+    external = _fake_worker(lease=b"l", spawned_at=9.0, external=True)
+    idle = _fake_worker(spawned_at=1.0)
+    agent = _agent_with([external, idle])
+    victim, _ = agent._pick_oom_victim()
+    assert victim is None

@@ -126,3 +126,4 @@ def test_pip_venv_isolation(cluster, tmp_path):
     c = UsesPkg.options(
         runtime_env={"pip": [str(pkg)]}).remote()
     assert ray_tpu.get(c.magic.remote(), timeout=120) == "venv-isolated-42"
+

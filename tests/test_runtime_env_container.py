@@ -1,0 +1,102 @@
+"""Container runtime env + venv cache GC (r5): standalone clusters —
+these tests must own the whole driver state (the container template is
+captured by the agent at cluster start), so they live apart from the
+shared-cluster runtime-env module.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+def test_container_runtime_env_stub(tmp_path):
+    """image_uri runtime env (reference: _private/runtime_env/
+    image_uri.py): the worker's command is built from the container
+    template — a stub container records the invocation (image, env
+    flags, mounts) then execs the real worker, so the actor works end
+    to end 'inside' the container."""
+    import json
+    import sys
+
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.utils.config import GlobalConfig
+
+    record = str(tmp_path / "container_calls.jsonl")
+    stub = ("import json, os, sys\n"
+            "open(sys.argv[1], 'a').write(json.dumps(sys.argv[2:]) + '\\n')\n"
+            "os.execv(sys.executable,"
+            " [sys.executable, '-m', 'ray_tpu.core.worker_main'])\n")
+    template = [sys.executable, "-c", stub, record,
+                "-v", "{session_dir}:{session_dir}",
+                "{env_flags}", "{image}"]
+    GlobalConfig.initialize({
+        "container_run_template": json.dumps(template)})
+    c = Cluster(num_nodes=1, resources={"CPU": 2})
+    c.connect()
+    try:
+        @ray_tpu.remote
+        class InContainer:
+            def ping(self):
+                return "containered"
+
+        a = InContainer.options(runtime_env={
+            "image_uri": "ghcr.io/example/raytpu:latest"}).remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=120) == "containered"
+        calls = [json.loads(ln) for ln in open(record)]
+        assert len(calls) == 1
+        argv = calls[0]
+        assert "ghcr.io/example/raytpu:latest" in argv
+        # Session-dir mount substituted; runtime env vars passed --env.
+        assert any(":" in p and p.split(":")[0] == p.split(":")[1]
+                   for p in argv if p.count(":") == 1 and "/" in p)
+        assert any(p.startswith("--env=RAY_TPU_AGENT_ADDR=")
+                   for p in argv)
+    finally:
+        c.shutdown()
+        GlobalConfig._overrides.clear()
+        GlobalConfig._cache.clear()
+
+
+def test_venv_cache_gc_evicts_lru(tmp_path):
+    """Cached runtime-env venvs are LRU-evicted past the size cap;
+    venvs in use by live workers survive (reference: runtime env cache
+    GC del_uri/cache size)."""
+    import types
+
+    from ray_tpu.core.node_agent import NodeAgent
+    from ray_tpu.utils.config import GlobalConfig
+
+    agent = NodeAgent.__new__(NodeAgent)  # no cluster needed
+    agent.session_dir = str(tmp_path)
+    agent.workers = {}
+    agent._pending_registration = {}
+    root = tmp_path / "venvs"
+    for i, age in enumerate((100, 50, 10)):  # older => smaller mtime
+        d = root / f"env{i}"
+        (d / "bin").mkdir(parents=True)
+        (d / "payload").write_bytes(b"x" * 4096)
+        (d / "bin" / "python").write_text("")
+        ready = d / "READY"
+        ready.write_text("")
+        os.utime(ready, (1_000_000 - age, 1_000_000 - age))
+
+    # env1 (middle-aged) is in use by a live worker: never evicted.
+    w = types.SimpleNamespace(
+        python_exe=str(root / "env1" / "bin" / "python"))
+    agent.workers = {b"w": w}
+
+    GlobalConfig.initialize({"runtime_env_cache_bytes": 9000})
+    try:
+        evicted = agent._gc_venv_cache()
+        # Total ~12KB > 9KB cap: the OLDEST unused (env0) goes; env1 is
+        # pinned in-use; env2 is newest.
+        assert [os.path.basename(d) for d in evicted] == ["env0"]
+        assert not (root / "env0").exists()
+        assert (root / "env1").exists() and (root / "env2").exists()
+        # Under the cap afterwards: a second pass evicts nothing.
+        assert agent._gc_venv_cache() == []
+    finally:
+        GlobalConfig._overrides.clear()
+        GlobalConfig._cache.clear()
