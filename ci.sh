@@ -13,12 +13,14 @@ python -m pytest tests/ -m fast -q
 echo "=== stage 2: slow suites (chunked) ==="
 python -m pytest tests/test_chaos.py tests/test_oom.py \
     tests/test_spilling.py tests/test_gcs_ft.py -q
-python -m pytest tests/test_train.py tests/test_checkpointing.py -q
+python -m pytest tests/test_train.py tests/test_checkpointing.py \
+    tests/test_train_elastic.py -q
 python -m pytest tests/test_runtime_multinode.py tests/test_data.py \
     tests/test_device_plane.py -q
 python -m pytest tests/test_serve_llm.py tests/test_tune.py \
     tests/test_rllib.py -q
 python -m pytest tests/test_ops.py tests/test_model_parallel.py \
-    tests/test_autoscaler.py tests/test_jobs_util.py -q
+    tests/test_autoscaler.py tests/test_jobs_util.py \
+    tests/test_runtime_env_container.py -q
 
 echo "=== all suites green ==="

@@ -531,10 +531,11 @@ class NodeAgent:
             return []
         in_use = set()
         # Workers still between spawn and registration count too — their
-        # interpreter may be starting from the venv right now.
-        import itertools
-        for w in itertools.chain(self.workers.values(),
-                                 self._pending_registration.values()):
+        # interpreter may be starting from the venv right now. Snapshots:
+        # this runs on an executor thread while the loop mutates the
+        # dicts.
+        for w in (list(self.workers.values())
+                  + list(self._pending_registration.values())):
             exe = getattr(w, "python_exe", None)
             if exe and exe.startswith(root):
                 # <root>/<key>/bin/python -> <root>/<key>
@@ -555,10 +556,13 @@ class NodeAgent:
             entries.append((mtime, d, size))
             total += size
         evicted = []
-        for _, d, size in sorted(entries):  # oldest READY first
+        now = time.time()
+        for mtime, d, size in sorted(entries):  # oldest READY first
             if total <= cap:
                 break
-            if d in in_use:
+            # Grace window: a just-touched READY means a lock-free reuse
+            # may be handing this venv out right now.
+            if d in in_use or now - mtime < 60.0:
                 continue
             shutil.rmtree(d, ignore_errors=True)
             total -= size
@@ -580,14 +584,18 @@ class NodeAgent:
         venv_dir = os.path.join(self.session_dir, "venvs", key)
         python = os.path.join(venv_dir, "bin", "python")
         ready = os.path.join(venv_dir, "READY")
-        if os.path.exists(ready):
+        try:
             os.utime(ready)  # LRU touch: reuse refreshes eviction order
             return python
+        except OSError:
+            pass  # absent, or GC raced the touch: take the locked path
         lock = self._venv_locks.setdefault(key, asyncio.Lock())
         async with lock:
-            if os.path.exists(ready):
+            try:
                 os.utime(ready)
                 return python
+            except OSError:
+                pass
             loop = asyncio.get_running_loop()
             # One GC at a time: two concurrent sweeps could rmtree a dir
             # the other is mid-os.walk on.
@@ -634,7 +642,8 @@ class NodeAgent:
 
     def _container_argv(self, image_uri: str, env: Dict[str, str],
                         user_env: Optional[Dict[str, str]] = None,
-                        memory_bytes: Optional[int] = None) -> List[str]:
+                        memory_bytes: Optional[int] = None,
+                        cpus: Optional[float] = None) -> List[str]:
         """Worker argv for an image_uri runtime env (reference:
         _private/runtime_env/image_uri.py — the worker process runs
         inside a container). The command is a TEMPLATE from config
@@ -656,6 +665,8 @@ class NodeAgent:
         env_flags = [f"--env={k}={v}" for k, v in sorted(forward.items())]
         mem_flags = ([f"--memory={int(memory_bytes)}"]
                      if memory_bytes else [])
+        if cpus:
+            mem_flags.append(f"--cpus={cpus}")
         argv: List[str] = []
         for part in template:
             if part == "{env_flags}":
@@ -704,12 +715,12 @@ class NodeAgent:
                                            rlimit_preexec)
         scope = None
         preexec = None
-        container_mem = None
+        container_mem = container_cpus = None
         if image_uri:
             # Host cgroups/rlimits would bind the podman CLIENT, not the
             # containerized workload — the container runtime enforces the
-            # memory cap instead ({memory_flags} in the template).
-            container_mem = memory_bytes
+            # memory/CPU caps instead ({memory_flags} in the template).
+            container_mem, container_cpus = memory_bytes, cpus
             memory_bytes = None
             cpus = None
         if memory_bytes or cpus:
@@ -726,7 +737,8 @@ class NodeAgent:
         if image_uri:
             argv = self._container_argv(image_uri, env,
                                         user_env=extra_env,
-                                        memory_bytes=container_mem)
+                                        memory_bytes=container_mem,
+                                        cpus=container_cpus)
         else:
             argv = [python_exe or sys.executable, "-m",
                     "ray_tpu.core.worker_main"]

@@ -290,6 +290,27 @@ class TrainController:
         finally:
             self._teardown(pg, workers)
 
+    def _ingest_polls(self, polls) -> None:
+        """Fold workers' reported (metrics, checkpoint) pairs into the
+        run state (rank 0's metrics are the history)."""
+        for rank, p in enumerate(polls):
+            for metrics, ckpt in p["reported"]:
+                if rank == 0:
+                    self._metrics_history.append(metrics)
+                if ckpt is not None:
+                    # Ranks drain independently: only advance, never
+                    # regress, the resume point.
+                    new_step = getattr(ckpt, "step", None)
+                    cur_step = getattr(self._latest_checkpoint, "step",
+                                       None)
+                    if (new_step is None or cur_step is None
+                            or new_step >= cur_step):
+                        self._latest_checkpoint = ckpt
+                    if rank == 0 and self._ckpt_manager is not None:
+                        from ray_tpu.train.checkpointing import Checkpoint
+                        if isinstance(ckpt, Checkpoint):
+                            self._ckpt_manager.register(ckpt)
+
     def _poll_until_done(self, workers) -> Result:
         poll_period = 0.2
         while True:
@@ -299,24 +320,7 @@ class TrainController:
             except Exception as e:  # worker/actor death mid-training
                 raise TrainingFailedError(
                     f"worker poll failed: {e!r}") from e
-            for rank, p in enumerate(polls):
-                for metrics, ckpt in p["reported"]:
-                    if rank == 0:
-                        self._metrics_history.append(metrics)
-                    if ckpt is not None:
-                        # Ranks drain independently: only advance, never
-                        # regress, the resume point.
-                        new_step = getattr(ckpt, "step", None)
-                        cur_step = getattr(self._latest_checkpoint, "step",
-                                           None)
-                        if (new_step is None or cur_step is None
-                                or new_step >= cur_step):
-                            self._latest_checkpoint = ckpt
-                        if rank == 0 and self._ckpt_manager is not None:
-                            from ray_tpu.train.checkpointing import \
-                                Checkpoint
-                            if isinstance(ckpt, Checkpoint):
-                                self._ckpt_manager.register(ckpt)
+            self._ingest_polls(polls)
             errs = [(i, p["error"]) for i, p in enumerate(polls)
                     if p["status"] == "error"]
             if errs:
@@ -327,6 +331,18 @@ class TrainController:
                 final = self._metrics_history[-1] \
                     if self._metrics_history else {}
                 return Result(metrics=final)
-            self._maybe_request_resize()
+            try:
+                self._maybe_request_resize()
+            except _ResizeRequested:
+                # A report can race the resize decision (the worker
+                # reported between our poll and the policy check): drain
+                # once more so the pre-resize history survives the
+                # attempt restart.
+                try:
+                    self._ingest_polls(ray_tpu.get(
+                        [w.poll.remote() for w in workers], timeout=30))
+                except Exception:
+                    pass
+                raise
             time.sleep(poll_period)
             poll_period = min(poll_period * 1.5, 2.0)

@@ -48,10 +48,10 @@ import signal as _signal
 # the 1-core CI host).
 _SLOW_MODULES = {
     "test_chaos", "test_oom", "test_spilling", "test_gcs_ft",
-    "test_train", "test_runtime_multinode", "test_serve_llm",
-    "test_checkpointing", "test_tune", "test_rllib", "test_ops",
-    "test_model_parallel", "test_data", "test_device_plane",
-    "test_autoscaler", "test_jobs_util",
+    "test_train", "test_train_elastic", "test_runtime_multinode",
+    "test_serve_llm", "test_checkpointing", "test_tune", "test_rllib",
+    "test_ops", "test_model_parallel", "test_data", "test_device_plane",
+    "test_autoscaler", "test_jobs_util", "test_runtime_env_container",
 }
 
 _DEFAULT_TIMEOUT_S = 180
