@@ -95,6 +95,29 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """Dump every worker's Python stacks (reference: `ray stack`)."""
+    _connect(args.address)
+    from ray_tpu import state
+    dump = state.stack(args.node)
+    for nid, workers in dump.items():
+        print(f"=== node {nid} ===")
+        if "error" in workers:
+            print(f"  <unreachable: {workers['error']}>")
+            continue
+        for pid, entry in workers.items():
+            who = f"actor {entry['actor']}" if entry.get("actor") \
+                else f"worker {entry.get('worker_id', '?')}"
+            print(f"--- pid {pid} ({who}, via {entry.get('via', '?')}) ---")
+            for name, text in entry.get("stacks", {}).items():
+                print(f"  [{name}]")
+                for line in text.splitlines():
+                    print(f"    {line}")
+            if entry.get("error"):
+                print(f"  <error: {entry['error']}>")
+    return 0
+
+
 def cmd_metrics(args) -> int:
     _connect(args.address)
     from ray_tpu import state
@@ -181,6 +204,13 @@ def main(argv=None) -> int:
                     choices=["actors", "nodes", "tasks", "workers"])
     sp.add_argument("--address", required=True)
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("stack", help="dump worker Python stacks "
+                        "(hung-worker debugger)")
+    sp.add_argument("--address", required=True)
+    sp.add_argument("--node", default=None,
+                    help="node id prefix (default: all nodes)")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("timeline")
     sp.add_argument("--address", required=True)

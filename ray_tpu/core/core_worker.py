@@ -317,6 +317,22 @@ class CoreWorker:
                 self.controller, "log_events", _print_log,
                 from_latest=True).start()
 
+    async def worker_stacks(self) -> Dict[str, str]:
+        """Python stacks of every thread in this process (the `ray stack`
+        analogue's fast path, reference: scripts.py:2706 — py-spy dump).
+        Served from the IO loop, so a task wedged on its EXEC thread
+        still answers; a wedged io loop falls back to the agent's
+        SIGUSR1/faulthandler path."""
+        import sys
+        import threading
+        import traceback
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for tid, frame in sys._current_frames().items():
+            label = f"{names.get(tid, 'thread')}-{tid}"
+            out[label] = "".join(traceback.format_stack(frame))
+        return out
+
     async def retarget_controller(self, addr) -> bool:
         """Follow a controller head failover: swap the controller client
         to the replacement's address (the durable-store restart path).

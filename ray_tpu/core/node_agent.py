@@ -684,6 +684,8 @@ class NodeAgent:
                       cpus: Optional[float] = None,
                       image_uri: Optional[str] = None) -> WorkerProc:
         env = dict(os.environ)
+        stack_token = f"{os.getpid()}-{self._worker_seq}-{time.time_ns()}"
+        env["RAY_TPU_STACK_TOKEN"] = stack_token
         env["RAY_TPU_AGENT_ADDR"] = f"{self.host}:{self.port}"
         env["RAY_TPU_CONTROLLER_ADDR"] = \
             f"{self.controller_addr[0]}:{self.controller_addr[1]}"
@@ -760,6 +762,7 @@ class NodeAgent:
         w = WorkerProc(proc, b"")
         w.cgroup_scope = scope
         w.python_exe = python_exe  # venv-GC in-use marker
+        w.stack_token = stack_token
         self._pending_registration[proc.pid] = w
         if capture:
             self._start_log_pump(proc)
@@ -1576,6 +1579,65 @@ class NodeAgent:
                     await client.close()
                 except Exception:
                     pass
+
+    async def dump_stacks(self) -> dict:
+        """Python stacks of every live worker on this node (reference:
+        `ray stack`, scripts.py:2706). Fast path: the worker's own
+        worker_stacks RPC (io loop alive). Fallback for a WEDGED worker:
+        SIGUSR1 triggers its faulthandler dump to
+        <session>/stacks/<pid>.txt, which we read back — that path works
+        as long as the process can run signal handlers."""
+        import signal
+        out: dict = {}
+        for w in list(self.workers.values()):
+            if not isinstance(w.proc, subprocess.Popen) \
+                    or w.proc.poll() is not None:
+                continue
+            pid = w.proc.pid
+            entry = {"worker_id": w.worker_id.hex()[:12],
+                     "actor": (w.dedicated_actor.hex()[:12]
+                               if w.dedicated_actor else None)}
+            stacks = None
+            if w.client is not None:
+                try:
+                    stacks = await asyncio.wait_for(
+                        w.client.call("worker_stacks"), timeout=2.0)
+                    entry["via"] = "rpc"
+                except Exception as e:
+                    entry["rpc_error"] = repr(e)  # kept for diagnosis
+                    stacks = None
+            if stacks is None:
+                token = getattr(w, "stack_token", None) or str(pid)
+                path = os.path.join(self.session_dir, "stacks",
+                                    f"{token}.txt")
+                try:
+                    # Never truncate: the worker's faulthandler fd keeps
+                    # its own offset (a truncate would leave NUL padding
+                    # before the next dump). Read only the bytes this
+                    # signal appends, polling until the handler ran.
+                    pre = os.path.getsize(path) \
+                        if os.path.exists(path) else 0
+                    os.kill(pid, signal.SIGUSR1)
+                    text = ""
+                    deadline = asyncio.get_running_loop().time() + 2.0
+                    while asyncio.get_running_loop().time() < deadline:
+                        await asyncio.sleep(0.05)
+                        if os.path.exists(path) \
+                                and os.path.getsize(path) > pre:
+                            await asyncio.sleep(0.05)  # let it finish
+                            with open(path) as f:
+                                f.seek(pre)
+                                text = f.read()
+                            break
+                    stacks = {"faulthandler": text} if text else None
+                    entry["via"] = "signal"
+                    if not text:
+                        entry["error"] = "signal dump timed out"
+                except Exception as e:
+                    entry["error"] = repr(e)
+            entry["stacks"] = stacks or {}
+            out[pid] = entry
+        return out
 
     async def agent_stats(self) -> dict:
         return {

@@ -20,6 +20,23 @@ def main() -> None:
     from ray_tpu.utils.logging import configure
     configure("worker", session_dir)
 
+    # Signal-path stack dumps (reference: `ray stack` via py-spy,
+    # scripts.py:2706): SIGUSR1 makes faulthandler write every thread's
+    # Python stack to a per-pid file the agent reads — works even when
+    # the worker's event loop is wedged (the RPC stack path cannot).
+    import faulthandler
+    import signal
+    stacks_dir = os.path.join(session_dir, "stacks")
+    os.makedirs(stacks_dir, exist_ok=True)
+    # Named by an agent-assigned token, not os.getpid(): a containerized
+    # worker's in-namespace pid differs from the host pid the agent
+    # knows. Appends accumulate; the agent reads only the bytes written
+    # after each signal it sends.
+    token = os.environ.get("RAY_TPU_STACK_TOKEN", str(os.getpid()))
+    _stack_file = open(os.path.join(stacks_dir, f"{token}.txt"), "a")
+    faulthandler.register(signal.SIGUSR1, file=_stack_file,
+                          all_threads=True)
+
     from ray_tpu.core.core_worker import CoreWorker
 
     cw = CoreWorker("worker", (agent_host, int(agent_port)),

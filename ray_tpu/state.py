@@ -90,3 +90,27 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def stack(node_id: Optional[str] = None) -> dict:
+    """Python stack traces of every worker on every (or one) node — the
+    hung-worker debugger (reference: `ray stack`, scripts.py:2706 via
+    py-spy; here the worker's own stacks RPC with a SIGUSR1/faulthandler
+    fallback for wedged event loops). Returns
+    {node_id_hex: {pid: {stacks, via, worker_id, actor}}}."""
+    from ray_tpu import api
+    cw = api._cw()
+    out = {}
+    for n in list_nodes():
+        nid = n["node_id"]
+        if node_id and not nid.startswith(node_id):
+            continue
+        if n.get("state") != "ALIVE":
+            continue
+        host, port = n["addr"].rsplit(":", 1)
+        try:
+            agent = cw._client_for_worker((host, int(port)))
+            out[nid] = cw._run(agent.call("dump_stacks")).result(30)
+        except Exception as e:
+            out[nid] = {"error": repr(e)}
+    return out

@@ -56,3 +56,53 @@ def test_endpoints(dash):
         raise AssertionError("expected 404")
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_tasks_workers_jobs_endpoints(dash):
+    """The remaining API routes return well-formed JSON (reference:
+    dashboard modules for tasks/jobs)."""
+    @ray_tpu.remote
+    def traced():
+        return 7
+
+    assert ray_tpu.get(traced.remote()) == 7
+
+    status, body = _get(dash, "/api/tasks")
+    assert status == 200
+    tasks = json.loads(body)
+    assert isinstance(tasks, list) and tasks
+    assert any(t.get("state") == "FINISHED" or t.get("event")
+               for t in tasks), tasks[:3]
+
+    status, body = _get(dash, "/api/workers")
+    assert status == 200
+    assert isinstance(json.loads(body), list)
+
+    status, body = _get(dash, "/api/jobs")
+    assert status == 200
+    assert isinstance(json.loads(body), list)
+
+
+def test_summary_tracks_actor_lifecycle(dash):
+    """Summary counts respond to actor churn."""
+    import time
+
+    @ray_tpu.remote
+    class Churn:
+        def ping(self):
+            return 1
+
+    a = Churn.options(name="churn-dash").remote()
+    ray_tpu.get(a.ping.remote())
+    s1 = json.loads(_get(dash, "/api/summary")[1])
+    assert s1["actors"] >= 1
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        actors = json.loads(_get(dash, "/api/actors")[1])
+        dead = [x for x in actors if x.get("name") == "churn-dash"
+                and x["state"] == "DEAD"]
+        if dead:
+            break
+        time.sleep(0.2)
+    assert dead, actors

@@ -59,3 +59,33 @@ def test_event_export_jsonl(tmp_path):
         c.shutdown()
         GlobalConfig._overrides.clear()
         GlobalConfig._cache.clear()
+
+
+def test_event_exporter_unit_flush_and_resilience(tmp_path):
+    """EventExporter mechanics: buffered batching, explicit flush,
+    non-JSONable payload coercion, and write-failure resilience (export
+    must never take down the control plane)."""
+    from ray_tpu.utils.events import EventExporter
+
+    path = str(tmp_path / "ev.jsonl")
+    ex = EventExporter(path)
+    ex.emit("test", {"n": 1, "blob": b"\x00\xff", "id": b"abcd" * 5})
+    assert not os.path.exists(path)  # buffered, below batch size
+    ex.flush()
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["source"] == "test" and recs[0]["event"]["n"] == 1
+    # bytes coerced to a JSON-safe form
+    json.dumps(recs)
+
+    # Batch flush at _FLUSH_EVERY without an explicit flush().
+    for i in range(EventExporter._FLUSH_EVERY):
+        ex.emit("bulk", {"i": i})
+    recs = [json.loads(ln) for ln in open(path)]
+    assert sum(1 for r in recs if r["source"] == "bulk") \
+        == EventExporter._FLUSH_EVERY
+
+    # Unwritable sink: emit/flush must not raise.
+    bad = EventExporter(str(tmp_path / "dir-as-file"))
+    os.makedirs(str(tmp_path / "dir-as-file"), exist_ok=True)
+    bad.emit("x", {"a": 1})
+    bad.flush()

@@ -122,3 +122,38 @@ def test_cli_status_and_list(cluster):
         capture_output=True, text=True, timeout=120, env=env)
     assert out.returncode == 0, out.stderr
     assert json.loads(out.stdout)[0]["state"] == "ALIVE"
+
+
+def test_stack_dump_finds_hung_worker(cluster):
+    """`ray_tpu stack` analogue (reference: scripts.py:2706 py-spy
+    stack): the dump must show the exact user frame a hung actor is
+    stuck in — the io-loop RPC path answers even while the exec thread
+    sleeps."""
+    import time
+
+    from ray_tpu import state
+
+    @ray_tpu.remote
+    class Stuck:
+        def hang_here_forever(self):
+            time.sleep(30)
+            return "done"
+
+        def ping(self):
+            return "pong"
+
+    a = Stuck.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ref = a.hang_here_forever.remote()  # noqa: F841 — keep in flight
+    time.sleep(1.0)  # the exec thread is now inside time.sleep
+
+    dump = state.stack()
+    assert dump, "no nodes in the stack dump"
+    texts = []
+    for workers in dump.values():
+        for entry in workers.values():
+            assert entry.get("via") in ("rpc", "signal"), entry
+            texts.extend(entry.get("stacks", {}).values())
+    joined = "\n".join(texts)
+    assert "hang_here_forever" in joined, joined[-2000:]
+    ray_tpu.kill(a)
