@@ -95,9 +95,41 @@ def _make_prefill_core(mcfg):
         logits = jnp.einsum("bd,dv->bv", last_h,
                             params["lm_head"].astype(dt))
         first = jnp.argmax(logits[0]).astype(jnp.int32)
-        return first, ks, vs
+        return first, ks, vs, logits[0].astype(jnp.float32)
 
     return core
+
+
+# Compile-time cap on per-request top_k (jax.lax.top_k needs a static
+# width; requests asking for more sample from the best TOPK_CAP).
+TOPK_CAP = 64
+
+
+def _sample_tokens(logits, temp, topk, keys, pos, cap=TOPK_CAP):
+    """Per-slot token sampling (reference: vLLM's sampler): temperature
+    + top-k via Gumbel-max over the top-`cap` logits (cap is a static
+    trace-time width, min(TOPK_CAP, vocab)); temp==0 slots stay greedy.
+    `keys` are per-slot base PRNG keys; folding in `pos` makes a
+    request's sample stream deterministic for its (seed, position)
+    regardless of slot assignment or co-tenants."""
+    import jax
+    import jax.numpy as jnp
+
+    cap = min(cap, logits.shape[-1])
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    vals, idxs = jax.lax.top_k(logits.astype(jnp.float32), cap)
+    k_eff = jnp.where(topk > 0, jnp.minimum(topk, cap), cap)
+    mask = jnp.arange(cap)[None, :] < k_eff[:, None]
+    scaled = jnp.where(mask, vals / jnp.maximum(temp, 1e-6)[:, None],
+                       -1e30)
+
+    def one_gumbel(key, p):
+        return jax.random.gumbel(jax.random.fold_in(key, p), (cap,))
+
+    g = jax.vmap(one_gumbel)(keys, pos)
+    pick = jnp.argmax(scaled + g, axis=-1)
+    sampled = jnp.take_along_axis(idxs, pick[:, None], axis=1)[:, 0]
+    return jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
 
 
 def _build_fns(mcfg, n_slots: int, chunk: int, page: int, n_pages: int):
@@ -141,13 +173,18 @@ def _build_fns(mcfg, n_slots: int, chunk: int, page: int, n_pages: int):
     # ------------------------------------------------------------------
     _core = _make_prefill_core(mcfg)
 
-    def prefill(params, kc, vc, pages, tokens, length):
+    def prefill(params, kc, vc, pages, tokens, length, temp, topk, key):
         """tokens [1, B] padded to a BUCKET width (powers of 2 up to
         max_seq — jax.jit compiles one program per bucket shape, so a
         short prompt pays a short prefill, not a max_seq one); writes
-        the slot's pages, returns the first generated token (greedy)."""
-        first, ks, vs = _core(params, tokens, length)
+        the slot's pages, returns the first generated token (sampled,
+        or greedy when temp == 0)."""
+        _, ks, vs, logits_row = _core(params, tokens, length)
         kc, vc = _write_pages(kc, vc, pages, ks, vs)
+        first = _sample_tokens(logits_row[None],
+                               jnp.asarray(temp)[None],
+                               jnp.asarray(topk)[None], key[None],
+                               jnp.asarray(length - 1)[None])[0]
         return kc, vc, first
 
     def adopt(kc, vc, pages, ks, vs):
@@ -204,7 +241,8 @@ def _build_fns(mcfg, n_slots: int, chunk: int, page: int, n_pages: int):
         x = x + (jax.nn.silu(gate) * up) @ lp["w_down"].astype(dt)
         return x, kc_l, vc_l
 
-    def _step(params, kc, vc, bt, last, pos, active, cos, sin):
+    def _step(params, kc, vc, bt, last, pos, active, cos, sin,
+              temp, topk, keys):
         act = active & (pos < S)
         x = jnp.take(params["embed"], last, axis=0).astype(dt)
 
@@ -218,19 +256,19 @@ def _build_fns(mcfg, n_slots: int, chunk: int, page: int, n_pages: int):
         x, (kc, vc) = jax.lax.scan(body, x, (params["layers"], kc, vc))
         x = rms_norm(x, params["final_norm"], mcfg.norm_eps)
         logits = x @ params["lm_head"].astype(dt)          # [ns, V]
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = _sample_tokens(logits, temp, topk, keys, pos)
         nxt = jnp.where(act, nxt, last)
         pos2 = jnp.where(act, pos + 1, pos)
         return kc, vc, nxt, pos2
 
-    def decode(params, kc, vc, bt, last, pos, active):
+    def decode(params, kc, vc, bt, last, pos, active, temp, topk, keys):
         cos, sin = rope_frequencies(hd, S, mcfg.rope_theta)
         out0 = jnp.zeros((ns, chunk), jnp.int32)
 
         def body(i, carry):
             kc, vc, last, pos, out = carry
             kc, vc, nxt, pos = _step(params, kc, vc, bt, last, pos,
-                                     active, cos, sin)
+                                     active, cos, sin, temp, topk, keys)
             out = out.at[:, i].set(nxt)
             return kc, vc, nxt, pos, out
 
@@ -253,18 +291,30 @@ def _build_fns(mcfg, n_slots: int, chunk: int, page: int, n_pages: int):
     return prefill_jit, decode_jit, adopt_jit, poke_jit, empty_caches
 
 
+def _seed_key(seed: int):
+    """Threefry key = [hi, lo] words of the seed — host-side PRNGKey
+    construction (no device round-trip at admit)."""
+    import numpy as np
+    return np.array([(seed >> 32) & 0xffffffff, seed & 0xffffffff],
+                    np.uint32)
+
+
 class _Request:
     __slots__ = ("ids", "max_tokens", "out", "produced", "slot",
-                 "adopt_kv", "first")
+                 "adopt_kv", "first", "temperature", "top_k", "seed")
 
     def __init__(self, ids: List[int], max_tokens: int,
                  adopt_kv: Optional[Tuple[Any, Any]] = None,
-                 first: int = -1):
+                 first: int = -1, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0):
         self.ids = ids
         self.max_tokens = max_tokens
         self.out: "queue.Queue[Optional[List[int]]]" = queue.Queue()
         self.produced = 0
         self.slot = -1
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
         # Disaggregated handoff: (ks, vs) prefilled elsewhere + the first
         # generated token (already streamed to the client by the prefill
         # side, so this engine never re-emits it).
@@ -327,6 +377,11 @@ class Engine:
         self._bt = np.zeros((n_slots, self.maxp), np.int32)
         self._pos = np.zeros(n_slots, np.int32)
         self._active = np.zeros(n_slots, bool)
+        # Per-slot sampling state (temp 0 = greedy; key seeded per
+        # request so streams are reproducible wherever the slot lands).
+        self._temp = np.zeros(n_slots, np.float32)
+        self._topk = np.zeros(n_slots, np.int32)
+        self._skeys = np.zeros((n_slots, 2), np.uint32)
         self._last_d = jnp.zeros(n_slots, jnp.int32)
         self._pos_d = jnp.zeros(n_slots, jnp.int32)
         self.peak_pages_used = 0
@@ -345,17 +400,21 @@ class Engine:
         # touch real KV state.
         self._warm = {self.buckets[0], self.buckets[-1]}
         null_pages = jnp.zeros(self.maxp, jnp.int32)
+        null_key = jnp.zeros(2, jnp.uint32)
         for width in sorted(self._warm):
             toks = jnp.zeros((1, width), jnp.int32)
             self._kc, self._vc, first = self._prefill(
-                self.params, self._kc, self._vc, null_pages, toks, 1)
+                self.params, self._kc, self._vc, null_pages, toks, 1,
+                0.0, 0, null_key)
             kv = jnp.zeros((mcfg.n_layers, width, mcfg.n_kv_heads,
                             mcfg.head_dim), mcfg.dtype)
             self._kc, self._vc = self._adopt(self._kc, self._vc,
                                              null_pages, kv, kv)
         self._kc, self._vc, self._last_d, self._pos_d, out = self._decode(
             self.params, self._kc, self._vc, jnp.asarray(self._bt),
-            self._last_d, self._pos_d, jnp.zeros(n_slots, bool))
+            self._last_d, self._pos_d, jnp.zeros(n_slots, bool),
+            jnp.asarray(self._temp), jnp.asarray(self._topk),
+            jnp.asarray(self._skeys))
         # Warm both poke variants: host-int `first` (adopt path) and
         # device-scalar `first` (prefill path).
         self._last_d, self._pos_d = self._poke(self._last_d, self._pos_d,
@@ -401,8 +460,9 @@ class Engine:
                 if self._stop:
                     return
                 toks = jnp.zeros((1, width), jnp.int32)
-                kc, vc, first = self._prefill(self.params, kc, vc,
-                                              null_pages, toks, 1)
+                kc, vc, first = self._prefill(
+                    self.params, kc, vc, null_pages, toks, 1, 0.0, 0,
+                    jnp.zeros(2, jnp.uint32))
                 int(first)  # host sync: compile fully landed
                 # Warm the PD adopt program for this width too (a first
                 # cross-pool handoff must not compile in the loop).
@@ -415,12 +475,17 @@ class Engine:
             # serving via the already-warm buckets
 
     # ------------------------------------------------------------------
-    def submit(self, ids: List[int], max_tokens: int) -> "queue.Queue":
+    def submit(self, ids: List[int], max_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0,
+               seed: int = 0) -> "queue.Queue":
         """Enqueue a request; returns its stream of token-chunk lists
-        (None terminates the stream)."""
+        (None terminates the stream). temperature 0 = greedy; top_k
+        bounds sampling to the best k logits (capped at TOPK_CAP); seed
+        makes the sample stream reproducible."""
         if self.error is not None or not self._thread.is_alive():
             raise RuntimeError(f"LLM engine died:\n{self.error}")
-        req = _Request(ids[: self.mcfg.max_seq - 1], max_tokens)
+        req = _Request(ids[: self.mcfg.max_seq - 1], max_tokens,
+                       temperature=temperature, top_k=top_k, seed=seed)
         if max_tokens <= 0:
             req.out.put(None)  # nothing to generate; skip the prefill too
             return req.out
@@ -430,16 +495,19 @@ class Engine:
         return req.out
 
     def submit_prefilled(self, ks: Any, vs: Any, length: int, first: int,
-                         max_tokens: int) -> "queue.Queue":
+                         max_tokens: int, *, temperature: float = 0.0,
+                         top_k: int = 0, seed: int = 0) -> "queue.Queue":
         """Adopt an externally-prefilled request (PD disaggregation): the
         KV [L, B, KVH, hd] was produced by a PrefillServer and handed
         over via DeviceRefs; this engine continues decoding from token
-        `first` at position `length`. The stream yields only tokens
-        AFTER `first` (the prefill side already delivered it)."""
+        `first` at position `length` with the given sampling params (the
+        FIRST token is the prefill side's greedy pick). The stream
+        yields only tokens AFTER `first`."""
         if self.error is not None or not self._thread.is_alive():
             raise RuntimeError(f"LLM engine died:\n{self.error}")
         req = _Request([0] * min(length, self.mcfg.max_seq - 1),
-                       max_tokens, adopt_kv=(ks, vs), first=first)
+                       max_tokens, adopt_kv=(ks, vs), first=first,
+                       temperature=temperature, top_k=top_k, seed=seed)
         if max_tokens <= 1:
             req.out.put(None)  # prefill's first token was the whole ask
             return req.out
@@ -535,11 +603,18 @@ class Engine:
                 toks[0, :len(req.ids)] = req.ids
                 self._kc, self._vc, first = self._prefill(
                     self.params, self._kc, self._vc, pages_arr,
-                    jnp.asarray(toks), len(req.ids))
+                    jnp.asarray(toks), len(req.ids),
+                    float(req.temperature), int(req.top_k),
+                    jnp.asarray(_seed_key(req.seed)))
             req.slot = slot
             self._slot_req[slot] = req
             self._pos[slot] = len(req.ids)
             self._active[slot] = True
+            # Sampling state applies on BOTH branches (a PD handoff
+            # continues decoding with the request's params).
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._skeys[slot] = _seed_key(req.seed)
             req.produced = 1
             # Device-side slot bookkeeping (async — never a host
             # round-trip; `first` stays a device scalar on the prefill
@@ -574,6 +649,8 @@ class Engine:
         self._free.extend(self._slot_pages[slot])
         self._slot_pages[slot] = []
         self._bt[slot, :] = 0
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
 
     def _finish(self, slot: int) -> None:
         req = self._slot_req[slot]
@@ -677,7 +754,10 @@ class Engine:
                 self._decode(self.params, self._kc, self._vc,
                              jnp.asarray(self._bt.copy()), self._last_d,
                              self._pos_d,
-                             jnp.asarray(self._active.copy()))
+                             jnp.asarray(self._active.copy()),
+                             jnp.asarray(self._temp.copy()),
+                             jnp.asarray(self._topk.copy()),
+                             jnp.asarray(self._skeys.copy()))
             self._pos = np.where(
                 self._active, np.minimum(self._pos + self.chunk, S),
                 self._pos).astype(np.int32)

@@ -76,11 +76,23 @@ class LLMServer:
 
     def __call__(self, body: Dict[str, Any]):
         """Streaming completion: yields decoded chunks (OpenAI-ish
-        request body: {"prompt": [...ids] | str, "max_tokens": N}).
-        Each concurrent request is a slot of the shared decode loop."""
+        request body: {"prompt": [...ids] | str, "max_tokens": N,
+        "temperature": T, "top_k": K, "seed": S} — temperature 0/absent
+        = greedy). Each concurrent request is a slot of the shared
+        decode loop."""
         ids = self._encode(body.get("prompt", [1]))
         max_new = int(body.get("max_tokens", 16))
-        stream = self.engine.submit(ids, max_new)
+        seed = body.get("seed")
+        if seed is None:
+            # OpenAI/vLLM semantics: absent seed = fresh entropy per
+            # request (a fixed default would make every client's
+            # "sampled" completion identical).
+            import random as _random
+            seed = _random.getrandbits(62)
+        stream = self.engine.submit(
+            ids, max_new,
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)), seed=int(seed))
         while True:
             toks = stream.get()
             if toks is None:
@@ -225,8 +237,8 @@ class PrefillServer:
                      if b >= len(ids) and b in self._warm)
         toks = np.zeros((1, width), np.int32)
         toks[0, :len(ids)] = ids
-        first, ks, vs = self._core(self.params, jnp.asarray(toks),
-                                   len(ids))
+        first, ks, vs, _ = self._core(self.params, jnp.asarray(toks),
+                                      len(ids))
         return {
             "first": int(first),
             "length": len(ids),
@@ -268,7 +280,10 @@ class DecodeServer:
             except Exception:
                 pass
         stream = self.engine.submit_prefilled(
-            ks, vs, meta["length"], meta["first"], meta["max_tokens"])
+            ks, vs, meta["length"], meta["first"], meta["max_tokens"],
+            temperature=float(meta.get("temperature", 0.0)),
+            top_k=int(meta.get("top_k", 0)),
+            seed=int(meta.get("seed", 0)))
         while True:
             toks = stream.get()
             if toks is None:
@@ -300,10 +315,19 @@ class PDIngress:
         max_new = int(body.get("max_tokens", 16))
         meta = self._prefill.options(method_name="prefill").remote(
             body).result(timeout=300)
+        # First token is the prefill side's greedy pick; sampling params
+        # govern the decode continuation.
         yield self._decode_text([meta["first"]])
         if max_new <= 1:
             return
         meta["max_tokens"] = max_new
+        meta["temperature"] = float(body.get("temperature", 0.0))
+        meta["top_k"] = int(body.get("top_k", 0))
+        seed = body.get("seed")
+        if seed is None:
+            import random as _random
+            seed = _random.getrandbits(62)
+        meta["seed"] = int(seed)
         for toks in self._decode.options(
                 method_name="decode_stream").stream(meta):
             yield self._decode_text(toks)
