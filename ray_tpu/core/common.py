@@ -96,6 +96,12 @@ class TaskSpec:
     # fault tolerance
     max_retries: int = 0
     retry_count: int = 0
+    # Distributed tracing (reference: util/tracing/tracing_helper.py —
+    # OTel span context injected into the task spec): trace_id names the
+    # whole task tree (the root task's id); parent_span is the
+    # submitting task's id (b"" when the driver submitted).
+    trace_id: bytes = b""
+    parent_span: bytes = b""
     # placement
     placement_group: Optional[bytes] = None
     pg_bundle_index: int = -1

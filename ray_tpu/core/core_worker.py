@@ -52,6 +52,15 @@ from ray_tpu.utils.config import GlobalConfig
 
 logger = get_logger("core_worker")
 
+# Ambient trace context: (trace_id, current_span). Exec THREADS use the
+# threading.local (run_in_executor does not propagate contextvars); async
+# actor methods use the ContextVar (isolated per asyncio task).
+import contextvars as _contextvars  # noqa: E402
+
+_trace_local = threading.local()
+_trace_ctxvar: "_contextvars.ContextVar" = _contextvars.ContextVar(
+    "ray_tpu_trace", default=None)
+
 PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 
 
@@ -375,16 +384,37 @@ class CoreWorker:
     # task events (owner-side; reference: task_event_buffer.cc)
     # ------------------------------------------------------------------
     def _record_task_event(self, task_id: bytes, name: str,
-                           event: str) -> None:
+                           event: str, trace_id: bytes = b"",
+                           parent_span: bytes = b"") -> None:
         import time as _time
         with self._task_events_lock:
-            self._task_events.append({
+            rec = {
                 "task_id": task_id.hex(), "name": name, "event": event,
-                "ts": _time.time(), "owner": self.worker_id.hex()[:8]})
+                "ts": _time.time(), "owner": self.worker_id.hex()[:8]}
+            if trace_id:
+                # Span model: span id == task id; these two fields make
+                # the cross-process task TREE reconstructable from the
+                # event stream (reference: tracing_helper.py spans).
+                rec["trace_id"] = trace_id.hex()
+                rec["parent_span"] = parent_span.hex() \
+                    if parent_span else ""
+            self._task_events.append(rec)
             full = (len(self._task_events)
                     >= GlobalConfig.task_events_batch_size)
         if full:
             self._flush_task_events()
+
+    def _trace_for_new_task(self, task_id: bytes) -> tuple:
+        """(trace_id, parent_span) for a task being submitted NOW: the
+        ambient trace context if this code runs inside a task (sync exec
+        thread or async actor method), else a fresh root whose trace_id
+        is the new task's own id."""
+        ctx = getattr(_trace_local, "ctx", None)
+        if ctx is None:
+            ctx = _trace_ctxvar.get()
+        if ctx is None:
+            return task_id, b""
+        return ctx[0], ctx[1]
 
     def _flush_task_events(self) -> None:
         with self._task_events_lock:
@@ -1456,8 +1486,18 @@ class CoreWorker:
         blob = cloudpickle.dumps(func)
         func_id = hashlib.sha1(blob).digest()
         if func_id not in self._exported_funcs:
-            self._run(self.controller.call(
-                "kv_put", "fn", func_id.hex(), blob, False)).result()
+            put = self.controller.call("kv_put", "fn", func_id.hex(),
+                                       blob, False)
+            if threading.get_ident() == getattr(self._io_thread, "ident",
+                                                None):
+                # Submitting from the io loop itself (an async actor
+                # method calling fn.remote): blocking _run().result()
+                # here would deadlock the loop. Export asynchronously —
+                # the EXECUTING worker's _load_function retries while
+                # the export is in flight.
+                self._spawn(self._export_bg(func_id, put))
+            else:
+                self._run(put).result()
             self._exported_funcs.add(func_id)
         try:
             self._func_id_cache[func] = func_id
@@ -1468,12 +1508,37 @@ class CoreWorker:
     async def _load_function(self, func_id: bytes) -> Any:
         fn = self._func_cache.get(func_id)
         if fn is None:
-            blob = await self.controller.call("kv_get", "fn", func_id.hex())
+            # Brief retry window: an owner submitting from its io loop
+            # exports the function table entry ASYNCHRONOUSLY, so a fast
+            # push can reach us before the kv_put lands.
+            blob = None
+            delay = 0.05
+            deadline = asyncio.get_running_loop().time() + 3.0
+            while True:
+                blob = await self.controller.call("kv_get", "fn",
+                                                  func_id.hex())
+                if blob is not None \
+                        or asyncio.get_running_loop().time() > deadline:
+                    break
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 0.4)
             if blob is None:
                 raise RuntimeError(f"function {func_id.hex()} not found")
             fn = cloudpickle.loads(blob)
             self._func_cache[func_id] = fn
         return fn
+
+    async def _export_bg(self, func_id: bytes, put_coro) -> None:
+        """Background function-table export (io-loop submissions): on
+        failure, un-mark the export so the NEXT submission retries it
+        instead of every executor timing out on a key that will never
+        arrive."""
+        try:
+            await put_coro
+        except Exception as e:
+            self._exported_funcs.discard(func_id)
+            logger.warning("function export %s failed: %r (will retry "
+                           "on next submission)", func_id.hex()[:12], e)
 
     # ------------------------------------------------------------------
     # task submission (owner side)
@@ -1537,8 +1602,11 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy,
             label_selector=label_selector,
         )
+        spec.trace_id, spec.parent_span = \
+            self._trace_for_new_task(task_id.binary())
         self._task_arg_refs[task_id.binary()] = held
-        self._record_task_event(task_id.binary(), spec.name, "submitted")
+        self._record_task_event(task_id.binary(), spec.name, "submitted",
+                                spec.trace_id, spec.parent_span)
         if streaming:
             from ray_tpu.core.ref import ObjectRefGenerator
             self._streams[task_id.binary()] = _StreamState()
@@ -1560,7 +1628,8 @@ class CoreWorker:
         try:
             await self._submit_with_retries(spec)
         except BaseException as e:  # mark all returns failed
-            self._record_task_event(spec.task_id, spec.name, "failed")
+            self._record_task_event(spec.task_id, spec.name, "failed",
+                                    spec.trace_id, spec.parent_span)
             err = e if isinstance(e, Exception) else WorkerCrashedError(repr(e))
             if spec.streaming:
                 self._fail_stream(spec.task_id, err)
@@ -1917,7 +1986,8 @@ class CoreWorker:
                             client: Optional[RpcClient] = None) -> None:
         self._record_task_event(
             spec.task_id, spec.name,
-            "failed" if reply.get("error") is not None else "finished")
+            "failed" if reply.get("error") is not None else "finished",
+            spec.trace_id, spec.parent_span)
         if reply.get("error") is not None:
             err = serialization.deserialize(reply["error"],
                                             reply["error_meta"])
@@ -2098,8 +2168,11 @@ class CoreWorker:
             caller_id=self.worker_id.binary(),
             max_retries=handle._max_task_retries,
         )
+        spec.trace_id, spec.parent_span = \
+            self._trace_for_new_task(task_id.binary())
         self._task_arg_refs[task_id.binary()] = held
-        self._record_task_event(task_id.binary(), spec.name, "submitted")
+        self._record_task_event(task_id.binary(), spec.name, "submitted",
+                                spec.trace_id, spec.parent_span)
         if streaming:
             from ray_tpu.core.ref import ObjectRefGenerator
             self._streams[task_id.binary()] = _StreamState()
@@ -2119,7 +2192,8 @@ class CoreWorker:
         try:
             await self._submit_actor_with_retries(spec)
         except BaseException as e:
-            self._record_task_event(spec.task_id, spec.name, "failed")
+            self._record_task_event(spec.task_id, spec.name, "failed",
+                                    spec.trace_id, spec.parent_span)
             err = e if isinstance(e, Exception) else WorkerCrashedError(repr(e))
             if spec.streaming:
                 self._fail_stream(spec.task_id, err)
@@ -2656,6 +2730,8 @@ class CoreWorker:
             # task is parked in the exec pool behind another task.
             def fn():
                 self._exec_threads[spec.task_id] = threading.get_ident()
+                _trace_local.ctx = (spec.trace_id or spec.task_id,
+                                    spec.task_id)
                 try:
                     if spec.task_id in self._exec_cancelled:
                         from ray_tpu.core.common import TaskCancelledError
@@ -2663,6 +2739,7 @@ class CoreWorker:
                             f"task {spec.name} cancelled")
                     return user_fn()
                 finally:
+                    _trace_local.ctx = None
                     self._exec_threads.pop(spec.task_id, None)
 
             if spec.streaming:
@@ -2670,7 +2747,12 @@ class CoreWorker:
             if async_method is not None:
                 # Async actor method: runs on the io loop, concurrent with
                 # other async methods (no exec-pool hop, no ordering).
-                result = await async_method(*args, **kwargs)
+                tok = _trace_ctxvar.set(
+                    (spec.trace_id or spec.task_id, spec.task_id))
+                try:
+                    result = await async_method(*args, **kwargs)
+                finally:
+                    _trace_ctxvar.reset(tok)
             else:
                 result = await loop.run_in_executor(self._exec_pool, fn)
         except BaseException as e:  # user error -> error payload to owner
@@ -2757,6 +2839,8 @@ class CoreWorker:
         def run_gen() -> int:
             from collections import deque
             self._exec_threads[spec.task_id] = threading.get_ident()
+            _trace_local.ctx = (spec.trace_id or spec.task_id,
+                                spec.task_id)
             try:
                 if spec.task_id in self._exec_cancelled:
                     raise TaskCancelledError(f"task {spec.name} cancelled")
@@ -2789,6 +2873,7 @@ class CoreWorker:
                     pending.popleft().result()
                 return count
             finally:
+                _trace_local.ctx = None
                 self._exec_threads.pop(spec.task_id, None)
 
         try:

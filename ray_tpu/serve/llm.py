@@ -192,6 +192,15 @@ class PrefillServer:
         self.cfg = cfg
         self.mcfg, self.params = _model_from_cfg(cfg)
         self._core = jax.jit(_make_prefill_core(self.mcfg))
+        from ray_tpu.serve.engine import _sample_tokens
+
+        def _sample_first(row, temp, topk, key, pos):
+            import jax.numpy as jnp
+            return _sample_tokens(row[None], jnp.asarray(temp)[None],
+                                  jnp.asarray(topk)[None], key[None],
+                                  jnp.asarray(pos)[None])[0]
+
+        self._sample1 = jax.jit(_sample_first)
         # Same bucket ladder + warm policy as the engine: smallest and
         # largest warm eagerly; intermediates warm in the background and
         # requests round UP to a warmed width until then (a synchronous
@@ -230,6 +239,7 @@ class PrefillServer:
         import numpy as np
 
         from ray_tpu.device_objects import device_put_ref
+        from ray_tpu.serve.engine import _seed_key
 
         ids = _encode_prompt(self.cfg, body.get("prompt", [1]))
         ids = ids[: self.mcfg.max_seq - 1]
@@ -237,8 +247,17 @@ class PrefillServer:
                      if b >= len(ids) and b in self._warm)
         toks = np.zeros((1, width), np.int32)
         toks[0, :len(ids)] = ids
-        first, ks, vs, _ = self._core(self.params, jnp.asarray(toks),
-                                      len(ids))
+        first, ks, vs, logits_row = self._core(
+            self.params, jnp.asarray(toks), len(ids))
+        temp = float(body.get("temperature", 0.0))
+        if temp > 0:
+            # Sample the FIRST token here with the same (seed, position)
+            # key derivation as the monolithic engine — identical seeds
+            # give identical streams across deployment topologies.
+            first = self._sample1(
+                logits_row, temp, int(body.get("top_k", 0)),
+                jnp.asarray(_seed_key(int(body.get("seed", 0)))),
+                len(ids) - 1)
         return {
             "first": int(first),
             "length": len(ids),
@@ -313,21 +332,21 @@ class PDIngress:
 
     def __call__(self, body: Dict[str, Any]):
         max_new = int(body.get("max_tokens", 16))
+        body = dict(body)
+        if body.get("seed") is None:
+            # Resolve the seed BEFORE prefill: the prefill side samples
+            # the first token with it, the decode side continues with it.
+            import random as _random
+            body["seed"] = _random.getrandbits(62)
         meta = self._prefill.options(method_name="prefill").remote(
             body).result(timeout=300)
-        # First token is the prefill side's greedy pick; sampling params
-        # govern the decode continuation.
         yield self._decode_text([meta["first"]])
         if max_new <= 1:
             return
         meta["max_tokens"] = max_new
         meta["temperature"] = float(body.get("temperature", 0.0))
         meta["top_k"] = int(body.get("top_k", 0))
-        seed = body.get("seed")
-        if seed is None:
-            import random as _random
-            seed = _random.getrandbits(62)
-        meta["seed"] = int(seed)
+        meta["seed"] = int(body["seed"])
         for toks in self._decode.options(
                 method_name="decode_stream").stream(meta):
             yield self._decode_text(toks)

@@ -186,6 +186,15 @@ def test_prefill_decode_disaggregation():
         # max_tokens=1: the prefill token alone completes the request.
         one = "".join(pd.stream({"prompt": [1, 2, 3, 4], "max_tokens": 1}))
         assert one == want[: len(one)] and one.count("<") == 1
+
+        # SAMPLED parity: the same (seed, position) key derivation on
+        # both topologies — PD output matches monolithic exactly,
+        # including the prefill-side-sampled FIRST token.
+        sampled_req = {"prompt": [1, 2, 3, 4], "max_tokens": 6,
+                       "temperature": 1.0, "seed": 77}
+        mono_s = "".join(mono.stream(dict(sampled_req)))
+        pd_s = "".join(pd.stream(dict(sampled_req)))
+        assert pd_s == mono_s, (pd_s, mono_s)
     finally:
         serve.shutdown()
         c.shutdown()

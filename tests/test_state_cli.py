@@ -157,3 +157,68 @@ def test_stack_dump_finds_hung_worker(cluster):
     joined = "\n".join(texts)
     assert "hang_here_forever" in joined, joined[-2000:]
     ray_tpu.kill(a)
+
+
+def test_trace_propagation_across_processes(cluster):
+    """OTel-style span context rides the task spec (reference:
+    util/tracing/tracing_helper.py): a driver-submitted task that
+    submits a NESTED task and calls an actor produces events whose
+    trace_id all match the root task's id, with parent_span pointing at
+    the submitting task — the cross-process task tree is
+    reconstructable from the event stream."""
+    import time
+
+    from ray_tpu import state
+
+    @ray_tpu.remote
+    def leaf(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class Helper:
+        async def assist(self):
+            # async actor method: nested submit inherits via contextvar
+            # (refs are awaitable; a blocking get would park the loop)
+            return await leaf.remote(10)
+
+    @ray_tpu.remote
+    def root_task():
+        h = Helper.remote()
+        a = ray_tpu.get(leaf.remote(1))       # nested from exec thread
+        b = ray_tpu.get(h.assist.remote())    # actor call + its nested
+        return a + b
+
+    assert ray_tpu.get(root_task.remote(), timeout=120) == 13
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        events = state.list_tasks(limit=1000)
+        roots = [e for e in events if e["name"] == "root_task"
+                 and e["event"] == "submitted"]
+        leaves = [e for e in events if e["name"] == "leaf"
+                  and e["event"] == "submitted"]
+        assists = [e for e in events if e["name"].endswith(".assist")
+                   and e["event"] == "submitted"]
+        if roots and len(leaves) >= 2 and assists:
+            break
+        time.sleep(0.3)
+    assert roots and len(leaves) >= 2 and assists, \
+        (len(roots), len(leaves), len(assists))
+    root = roots[-1]
+    # Root task: its own id IS the trace id; no parent.
+    assert root["trace_id"] == root["task_id"]
+    assert root["parent_span"] == ""
+    trace = root["trace_id"]
+    tree_leaves = [e for e in leaves if e.get("trace_id") == trace]
+    tree_assists = [e for e in assists if e.get("trace_id") == trace]
+    assert tree_leaves and tree_assists
+    # Direct children of the root task point their parent at it.
+    assert any(e["parent_span"] == root["task_id"]
+               for e in tree_leaves)
+    assert all(e["parent_span"] == root["task_id"]
+               for e in tree_assists)
+    # The leaf submitted INSIDE the actor method parents to the actor
+    # task's span, not the root — a 3-deep chain in one trace.
+    assist_id = tree_assists[-1]["task_id"]
+    assert any(e["parent_span"] == assist_id for e in tree_leaves), \
+        [(e["task_id"][:8], e["parent_span"][:8]) for e in tree_leaves]
