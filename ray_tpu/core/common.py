@@ -102,6 +102,9 @@ class TaskSpec:
     # submitting task's id (b"" when the driver submitted).
     trace_id: bytes = b""
     parent_span: bytes = b""
+    # Owner exported the function table entry asynchronously (io-loop
+    # submission): executors briefly retry a missing kv entry.
+    fn_async_export: bool = False
     # placement
     placement_group: Optional[bytes] = None
     pg_bundle_index: int = -1

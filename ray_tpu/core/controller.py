@@ -650,6 +650,16 @@ class Controller:
                                timeout: float = 120.0) -> dict:
         e = self.actors.get(actor_id)
         if e is None:
+            # Registration may be in flight (an owner on its io loop
+            # registers asynchronously; borrowed handles can race it):
+            # briefly wait for the actor to appear before declaring it
+            # unknown.
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while e is None and \
+                    asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.1)
+                e = self.actors.get(actor_id)
+        if e is None:
             raise KeyError(f"no such actor {actor_id.hex()}")
         while e.state in (ActorState.PENDING, ActorState.RESTARTING):
             try:

@@ -1471,7 +1471,7 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # function table
     # ------------------------------------------------------------------
-    def _export_function(self, func: Any) -> bytes:
+    def _export_function(self, func: Any) -> tuple:
         # Pickle once per function OBJECT (the reference pickles in
         # RemoteFunction once, not per submit) — re-pickling on the hot
         # path costs ~15% of async task dispatch. A mutated closure on
@@ -1482,38 +1482,43 @@ class CoreWorker:
         except TypeError:
             cached = None
         if cached is not None:
-            return cached
+            return cached, False
         blob = cloudpickle.dumps(func)
         func_id = hashlib.sha1(blob).digest()
         if func_id not in self._exported_funcs:
             put = self.controller.call("kv_put", "fn", func_id.hex(),
                                        blob, False)
-            if threading.get_ident() == getattr(self._io_thread, "ident",
-                                                None):
+            async_export = threading.get_ident() == getattr(
+                self._io_thread, "ident", None)
+            if async_export:
                 # Submitting from the io loop itself (an async actor
                 # method calling fn.remote): blocking _run().result()
                 # here would deadlock the loop. Export asynchronously —
                 # the EXECUTING worker's _load_function retries while
-                # the export is in flight.
+                # the export is in flight (spec.fn_async_export).
                 self._spawn(self._export_bg(func_id, put))
             else:
                 self._run(put).result()
             self._exported_funcs.add(func_id)
+        else:
+            async_export = False
         try:
             self._func_id_cache[func] = func_id
         except TypeError:
             pass
-        return func_id
+        return func_id, async_export
 
-    async def _load_function(self, func_id: bytes) -> Any:
+    async def _load_function(self, func_id: bytes,
+                             retry: bool = False) -> Any:
         fn = self._func_cache.get(func_id)
         if fn is None:
-            # Brief retry window: an owner submitting from its io loop
-            # exports the function table entry ASYNCHRONOUSLY, so a fast
-            # push can reach us before the kv_put lands.
+            # Retry window ONLY when the owner flagged an async export
+            # (io-loop submission): a fast push can beat the kv_put. A
+            # genuinely missing function stays a one-RPC failure.
             blob = None
             delay = 0.05
-            deadline = asyncio.get_running_loop().time() + 3.0
+            deadline = asyncio.get_running_loop().time() + \
+                (3.0 if retry else 0.0)
             while True:
                 blob = await self.controller.call("kv_get", "fn",
                                                   func_id.hex())
@@ -1573,7 +1578,15 @@ class CoreWorker:
             ref = ObjectRef(oid, self.address)
             self.add_local_ref(ref)
             held.append(ref)
-            self._run(self._do_put(oid.binary(), sv)).result()
+            if threading.get_ident() == getattr(self._io_thread, "ident",
+                                                None):
+                # Submitting from the io loop (async actor method):
+                # blocking here would deadlock it. The put completes in
+                # the background; the executing side's arg resolution
+                # waits on the entry's READY state, not on this call.
+                self._spawn(self._do_put(oid.binary(), sv))
+            else:
+                self._run(self._do_put(oid.binary(), sv)).result()
             return ("r", oid.binary(), self.address)
         return ("v", sv.to_bytes(), sv.meta())
 
@@ -1583,7 +1596,7 @@ class CoreWorker:
                     scheduling_strategy=None, label_selector=None,
                     name: str = ""):
         streaming = num_returns == "streaming"
-        func_id = self._export_function(func)
+        func_id, async_export = self._export_function(func)
         task_id = TaskID.random()
         held: List[ObjectRef] = []
         spec = TaskSpec(
@@ -1602,6 +1615,7 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy,
             label_selector=label_selector,
         )
+        spec.fn_async_export = async_export
         spec.trace_id, spec.parent_span = \
             self._trace_for_new_task(task_id.binary())
         self._task_arg_refs[task_id.binary()] = held
@@ -2135,11 +2149,21 @@ class CoreWorker:
         spec_blob = cloudpickle.dumps(creation)
         placement = ((placement_group, pg_bundle_index)
                      if placement_group is not None else None)
-        self._run(self.controller.call(
+        register = self.controller.call(
             "create_actor", actor_id.binary(), spec_blob, name, max_restarts,
             resources or {"CPU": 1.0}, placement,
             runtime_env=runtime_env,
-            label_selector=label_selector)).result()
+            label_selector=label_selector)
+        if threading.get_ident() == getattr(self._io_thread, "ident",
+                                            None):
+            # Creating an actor from an async actor method: the handle
+            # works immediately (actor_id is client-generated; method
+            # pushes wait on wait_actor_ready), so the controller
+            # registration can complete in the background rather than
+            # deadlocking the loop.
+            self._spawn(register)
+        else:
+            self._run(register).result()
         method_names = [m for m in dir(cls)
                         if not m.startswith("_") and callable(getattr(cls, m))]
         return ActorHandle(actor_id, name or cls.__name__, method_names,
@@ -2719,7 +2743,8 @@ class CoreWorker:
                     async_method = method
                 user_fn = lambda: method(*args, **kwargs)  # noqa: E731
             else:
-                func = await self._load_function(spec.func_id)
+                func = await self._load_function(
+                    spec.func_id, retry=spec.fn_async_export)
                 user_fn = lambda: func(*args, **kwargs)  # noqa: E731
 
             # The task->thread registration is made by the EXEC THREAD itself: with

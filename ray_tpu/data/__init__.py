@@ -22,3 +22,5 @@ __all__ = [
     "read_binary_files", "read_images", "read_webdataset",
     "read_lance", "preprocessors",
 ]
+
+from ray_tpu.data import llm  # noqa: E402,F401  (batch inference bridge)

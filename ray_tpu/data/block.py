@@ -22,6 +22,19 @@ except Exception:  # pragma: no cover
 Block = Union["pa.Table", Dict[str, np.ndarray], List[Any]]
 
 
+def _column_array(vals: list) -> "np.ndarray":
+    """Column values -> numpy, falling back to dtype=object for RAGGED
+    columns (per-row arrays/lists of differing lengths — e.g. token-id
+    prompts); a bare np.asarray would raise on the inhomogeneous
+    shape."""
+    try:
+        return np.asarray(vals)
+    except ValueError:
+        out = np.empty(len(vals), object)
+        out[:] = vals
+        return out
+
+
 class BlockAccessor:
     """Uniform view over the three block representations (reference:
     python/ray/data/block.py BlockAccessor.for_block)."""
@@ -88,8 +101,8 @@ class BlockAccessor:
             return b
         if b and isinstance(b[0], dict):
             keys = b[0].keys()
-            return {k: np.asarray([r[k] for r in b]) for k in keys}
-        return {"item": np.asarray(b)}
+            return {k: _column_array([r[k] for r in b]) for k in keys}
+        return {"item": _column_array(list(b))}
 
     def to_rows(self) -> List[Any]:
         b = self._b

@@ -50,16 +50,17 @@ class _Fused:
 class _ActorMapNode:
     """map_batches on a pool of long-lived actors."""
     __slots__ = ("fn", "batch_size", "batch_format", "concurrency",
-                 "ctor_args", "fn_kwargs")
+                 "ctor_args", "fn_kwargs", "resources")
 
     def __init__(self, fn, batch_size, batch_format, concurrency,
-                 ctor_args, fn_kwargs):
+                 ctor_args, fn_kwargs, resources=None):
         self.fn = fn
         self.batch_size = batch_size
         self.batch_format = batch_format
         self.concurrency = concurrency
         self.ctor_args = ctor_args
         self.fn_kwargs = fn_kwargs
+        self.resources = resources
 
 
 class _ExchangeNode:
@@ -124,7 +125,8 @@ class Dataset:
                     batch_format: str = "numpy",
                     fn_kwargs: Optional[dict] = None,
                     concurrency: Optional[int] = None,
-                    fn_constructor_args: tuple = ()) -> "Dataset":
+                    fn_constructor_args: tuple = (),
+                    resources: Optional[dict] = None) -> "Dataset":
         """Apply fn to batches (reference: dataset.py:457). With
         batch_size=None each block is one batch; otherwise blocks are
         re-chunked to batch_size rows (within a block; a trailing short
@@ -142,7 +144,7 @@ class Dataset:
                                  f"got {concurrency}")
             plan = list(self._plan) + [_ActorMapNode(
                 fn, batch_size, batch_format, concurrency,
-                fn_constructor_args, fn_kwargs or {})]
+                fn_constructor_args, fn_kwargs or {}, resources)]
             return Dataset._from_plan(
                 plan, f"{self._name}->map_batches(actors)")
         if isinstance(fn, type) or fn_constructor_args:
@@ -253,7 +255,7 @@ class Dataset:
                     op = ActorPoolMapOperator(
                         node.fn, node.ctor_args, node.fn_kwargs,
                         node.batch_size, node.batch_format,
-                        node.concurrency)
+                        node.concurrency, resources=node.resources)
                 elif isinstance(node, _ExchangeNode):
                     op = AllToAllOperator(node.fn, name=node.name)
                 else:

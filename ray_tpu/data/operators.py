@@ -250,12 +250,14 @@ class ActorPoolMapOperator(PhysicalOperator):
     def __init__(self, fn, ctor_args: tuple, fn_kwargs: dict,
                  batch_size: Optional[int], batch_format: str,
                  pool_size: int, name: str = "map(actors)",
-                 max_inflight_per_actor: int = 2):
+                 max_inflight_per_actor: int = 2,
+                 resources: Optional[dict] = None):
         super().__init__(name)
         import cloudpickle
         self._fn_blob = cloudpickle.dumps(fn)
         self._ctor_blob = cloudpickle.dumps(ctor_args)
         self._kwargs_blob = cloudpickle.dumps(fn_kwargs)
+        self._actor_resources = dict(resources or {})
         self._batch_size = batch_size
         self._batch_format = batch_format
         self._pool_size = pool_size
@@ -268,6 +270,19 @@ class ActorPoolMapOperator(PhysicalOperator):
     def start(self) -> None:
         from ray_tpu.data.dataset import _MapActor
         actor_cls = ray_tpu.remote(_MapActor)
+        if self._actor_resources:
+            # Pool actors with device/resource requests (e.g. one TPU
+            # per batch-inference engine — reference: map_batches
+            # num_gpus/resources options).
+            res = dict(self._actor_resources)
+            opts = {}
+            if "CPU" in res:
+                opts["num_cpus"] = res.pop("CPU")
+            if "TPU" in res:
+                opts["num_tpus"] = res.pop("TPU")
+            if res:
+                opts["resources"] = res
+            actor_cls = actor_cls.options(**opts)
         self._actors = [
             actor_cls.remote(self._fn_blob, self._ctor_blob,
                              self._batch_size, self._batch_format,

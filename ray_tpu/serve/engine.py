@@ -500,9 +500,10 @@ class Engine:
         """Adopt an externally-prefilled request (PD disaggregation): the
         KV [L, B, KVH, hd] was produced by a PrefillServer and handed
         over via DeviceRefs; this engine continues decoding from token
-        `first` at position `length` with the given sampling params (the
-        FIRST token is the prefill side's greedy pick). The stream
-        yields only tokens AFTER `first`."""
+        `first` at position `length` with the given sampling params
+        (`first` was chosen by the PREFILL side — sampled there with the
+        same seed derivation when temperature > 0). The stream yields
+        only tokens AFTER `first`."""
         if self.error is not None or not self._thread.is_alive():
             raise RuntimeError(f"LLM engine died:\n{self.error}")
         req = _Request([0] * min(length, self.mcfg.max_seq - 1),
