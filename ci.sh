@@ -23,4 +23,8 @@ python -m pytest tests/test_ops.py tests/test_model_parallel.py \
     tests/test_autoscaler.py tests/test_jobs_util.py \
     tests/test_runtime_env_container.py -q
 
+echo "=== native store sanitizers ==="
+RAY_TPU_SANITIZER_TESTS=1 python -m pytest \
+    tests/test_native_store.py::test_native_store_sanitizers -q
+
 echo "=== all suites green ==="

@@ -1890,12 +1890,23 @@ class CoreWorker:
                         if fut.done():  # cancelled/raced
                             q.pop(0)
                             continue
-                        if self._task_arg_refs.get(spec.task_id):
+                        if self._task_arg_refs.get(spec.task_id) \
+                                or spec.streaming:
+                            # Ref-args specs ship alone (dependency may
+                            # ride this batch's reply). STREAMING specs
+                            # ship alone too: the batch reply carries
+                            # each generator's streamed_total, so
+                            # coalescing would withhold every stream's
+                            # COMPLETION until the slowest generator in
+                            # the batch finishes — and a consumer that
+                            # gates later work on an earlier stream's
+                            # end (the Data executor's ordered emission)
+                            # deadlocks against it.
                             if batch:
                                 break  # close the ref-free run first
                             q.pop(0)
                             batch.append((spec, fut))
-                            break  # ref-args spec ships alone
+                            break
                         q.pop(0)
                         batch.append((spec, fut))
                     if not batch:

@@ -201,6 +201,8 @@ class NodeAgent:
         self.bundle_available: Dict[Tuple[bytes, int], Dict[str, float]] = {}
         self._peer_clients: Dict[Address, RpcClient] = {}
         self._resource_cv = asyncio.Condition()
+        self._lease_ticket_seq = 0
+        self._lease_waiters: Dict[int, dict] = {}  # FIFO grant order
         self._shutdown = False
 
     # ------------------------------------------------------------------
@@ -881,6 +883,56 @@ class NodeAgent:
         deadline = loop.time() + (
             queue_wait_ms if queue_wait_ms is not None
             else GlobalConfig.lease_queue_wait_ms) / 1000
+        # FIFO fairness ticket (reference: cluster_lease_manager.cc
+        # grants queued leases in order): without it, parked requests
+        # re-check in wake-rotation order and under scarcity the LAST
+        # submitted task can win every freed slot — reversing completion
+        # order and starving the head of the queue.
+        self._lease_ticket_seq += 1
+        ticket = self._lease_ticket_seq
+        waiters = self._lease_waiters
+        waiters[ticket] = {"resources": dict(resources), "pg": pg,
+                           "bundle": bundle_index,
+                           "labels": label_selector,
+                           "strategy": strategy}
+        try:
+            return await self._request_lease_inner(
+                ticket, deadline, resources, pg, bundle_index, strategy,
+                label_selector, _no_spill)
+        finally:
+            waiters.pop(ticket, None)
+            # A grant consumed resources; wake peers so the new head
+            # re-checks promptly.
+            async with self._resource_cv:
+                self._resource_cv.notify_all()
+
+    def _lease_head_blocked(self, ticket: int, avail, pg,
+                            bundle_index: int) -> bool:
+        """True when an EARLIER parked request drawing from the SAME
+        resource pool could also be satisfied by `avail` — this later
+        request defers to it (FIFO among satisfiable waiters). Waiters
+        that can never be granted locally (different PG bundle pool,
+        unmatched labels, hard affinity elsewhere) or don't fit never
+        block anyone — else a stuck head would idle the node."""
+        for t, w in self._lease_waiters.items():
+            if t >= ticket:
+                continue
+            if (w["pg"], w["bundle"]) != (pg, bundle_index):
+                continue  # disjoint pools can't contend
+            if w["pg"] is None and not (
+                    labels_match(self.labels, w["labels"])
+                    and self._strategy_allows_local(w["strategy"])):
+                continue  # never locally grantable: don't let it starve
+            if avail is not None and resources_fit(avail,
+                                                   w["resources"]):
+                return True
+        return False
+
+    async def _request_lease_inner(self, ticket: int, deadline: float,
+                                   resources: dict, pg, bundle_index,
+                                   strategy, label_selector,
+                                   _no_spill) -> dict:
+        loop = asyncio.get_running_loop()
         while True:
             # Placement-group tasks must run on the bundle's node.
             if pg is not None and (pg, bundle_index) not in self.bundle_available \
@@ -911,7 +963,9 @@ class NodeAgent:
                      if pg is not None else self.resources_available)
             if not local_ok:
                 avail = None
-            if avail is not None and resources_fit(avail, resources):
+            if avail is not None and resources_fit(avail, resources) \
+                    and not self._lease_head_blocked(ticket, avail, pg,
+                                                     bundle_index):
                 resources_sub(avail, resources)
                 try:
                     w = await self._pop_worker()

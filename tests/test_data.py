@@ -54,12 +54,30 @@ def test_iter_batches_exact_batching(cluster):
     assert all(s == 32 for s in sizes[:-1])  # re-chunked across blocks
 
 
-def test_streaming_overlap(cluster):
-    """Blocks must be consumable before the whole pipeline finishes."""
-    import time
+def test_streaming_overlap(cluster, tmp_path):
+    """Blocks must be consumable before the whole pipeline finishes.
+    Asserted as a HANDSHAKE, not wall-clock ratios (host-load-immune):
+    the LAST block's task blocks until the consumer proves it received
+    the FIRST batch — if outputs only surfaced after a full drain, the
+    pipeline would wedge on that handshake and trip the deadline."""
+    marker = str(tmp_path / "first-batch-consumed")
 
-    def slow_stage(batch):
-        time.sleep(0.4)
+    def slow_stage(batch, marker=marker):
+        import os as _os
+        import time as _t
+        if int(batch["id"][0]) // 64 == 7:
+            # Final block: wait (bounded) for the consumer's receipt of
+            # the first batch — only possible when earlier outputs are
+            # consumable while this task is still RUNNING.
+            deadline = _t.monotonic() + 30.0
+            while not _os.path.exists(marker):
+                if _t.monotonic() > deadline:
+                    raise RuntimeError(
+                        "consumer never saw the first batch while the "
+                        "last block was in flight: no streaming overlap")
+                _t.sleep(0.05)
+        else:
+            _t.sleep(0.05)
         return batch
 
     # Warm the worker pool first: on a loaded 1-core host, 8 cold worker
@@ -67,18 +85,11 @@ def test_streaming_overlap(cluster):
     rdata.range(8, num_blocks=8).map_batches(lambda b: b).take_all()
 
     ds = rdata.range(8 * 64, num_blocks=8).map_batches(slow_stage)
-    t0 = time.monotonic()
     it = iter(ds.iter_batches(batch_size=None))
     first = next(it)
-    first_s = time.monotonic() - t0
+    open(marker, "w").close()      # receipt: unblocks the final block
     n_rest = sum(1 for _ in it)
-    total_s = time.monotonic() - t0
     assert len(first["id"]) == 64 and n_rest == 7
-    # Ratio, not wall clock (this 1-core host's load varies 2x): with
-    # overlap the first batch lands well before the full drain; without
-    # it, first ~= total.
-    assert first_s < 0.75 * total_s, \
-        f"first batch at {first_s:.1f}s of {total_s:.1f}s (no overlap)"
 
 
 def test_materialize_and_split(cluster):
