@@ -1,0 +1,22 @@
+# Developer entry points. CI runs ci.sh (which includes `make lint`'s
+# invocation verbatim); these targets are the pieces, runnable alone.
+
+.PHONY: lint test fast native native-test
+
+# graftlint: framework-aware static analysis (event-loop safety, lock
+# discipline, Python<->C wire-schema drift, RPC signature drift, leaks).
+#   python -m ray_tpu.tools.lint --list-passes   for the pass list
+lint:
+	python -m ray_tpu.tools.lint
+
+fast:
+	python -m pytest tests/ -m fast -q
+
+test:
+	bash ci.sh
+
+native:
+	$(MAKE) -C csrc
+
+native-test:
+	$(MAKE) -C csrc test

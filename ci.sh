@@ -7,6 +7,12 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+echo "=== static analysis ==="
+# graftlint: event-loop safety, lock discipline, Python<->C wire-schema
+# drift, RPC handler-signature drift, task/coroutine leaks. Gates the
+# control plane (ray_tpu/core, serve, data) + csrc/store_server.cc.
+python -m ray_tpu.tools.lint
+
 echo "=== stage 1: fast suite ==="
 python -m pytest tests/ -m fast -q
 

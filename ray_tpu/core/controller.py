@@ -139,14 +139,34 @@ class Controller:
         from ray_tpu.core.store_client import (MemoryStoreClient,
                                                store_client_for)
         self._storage_path = GlobalConfig.gcs_storage_path
-        try:
-            self._store = store_client_for(self._storage_path)
-        except Exception as e:
-            # A corrupt/locked store must not crash-loop the head: start
-            # fresh (the pre-seam behavior for unreadable snapshots).
+        self._store = None
+        last_err: Optional[Exception] = None
+        # Transient lock/contention on the shared file during head
+        # failover heals in well under a second: retry before judging.
+        for attempt in range(3):
+            try:
+                self._store = store_client_for(self._storage_path)
+                break
+            except Exception as e:
+                last_err = e
+                time.sleep(0.25 * (attempt + 1))
+        if self._store is None:
+            if self._storage_path \
+                    and not GlobalConfig.gcs_storage_allow_empty_start:
+                # An explicitly configured durable store that will not
+                # open must FAIL FAST: silently "restoring" an empty
+                # cluster while agents re-register is exactly the data
+                # loss the durable store exists to prevent (r5 advisor;
+                # the reference's redis-backed GCS also hard-fails).
+                raise RuntimeError(
+                    f"controller durable store {self._storage_path!r} "
+                    f"failed to open: {last_err!r}. Repair the store, "
+                    "or set gcs_storage_allow_empty_start=1 to "
+                    "deliberately start with empty state.") from last_err
             logger.warning("could not open controller store %r: %r — "
-                           "starting with empty state",
-                           self._storage_path, e)
+                           "starting with empty state (override: "
+                           "gcs_storage_allow_empty_start)",
+                           self._storage_path, last_err)
             self._store = MemoryStoreClient()
         self._dirty = False
         if self._storage_path:
@@ -904,10 +924,20 @@ class Controller:
             import os
             path = os.path.join(self._pkg_dir(), key)
             if os.path.exists(path):
-                with open(path, "rb") as f:
-                    val = f.read()
-                self.kv.setdefault(ns, {})[key] = val
+                # Package blobs run to many MBs: read off the loop.
+                val = await asyncio.get_running_loop().run_in_executor(
+                    None, self._read_file_or_none, path)
+                if val is not None:
+                    self.kv.setdefault(ns, {})[key] = val
         return val
+
+    @staticmethod
+    def _read_file_or_none(path: str) -> Optional[bytes]:
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
 
     async def kv_del(self, ns: str, key: str) -> bool:
         self._mark_dirty()

@@ -143,6 +143,12 @@ class CoreWorker:
         self._local_ref_counts: Dict[bytes, int] = {}
         self._func_cache: Dict[bytes, Any] = {}
         self._exported_funcs: set = set()
+        # Exports whose background kv_put is still in flight: every
+        # submission during the window must flag async_export=True so
+        # the executor's _load_function keeps its retry window open
+        # (r5 advisor: only the FIRST submission did, and a fast cached
+        # re-submission could fail a single no-retry kv_get).
+        self._pending_exports: set = set()
         self._actor_instance: Any = None
         self._actor_id: Optional[bytes] = None
         # actor-task ordering: caller_id -> next expected seqno, plus one
@@ -1046,10 +1052,7 @@ class CoreWorker:
         if fp is None or total > 4 * 1024 * 1024:
             return False
         sdir = self._store_dir_cache
-        with self._fastpath_lock:  # puts run on arbitrary user threads
-            self._ingest_seq += 1
-            seq = self._ingest_seq
-        name = f"ingest-{os.getpid()}-{seq}"
+        name = self._next_ingest_name()
         path = os.path.join(sdir, name)
         try:
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
@@ -1078,6 +1081,17 @@ class CoreWorker:
         self._mark_ready_stored(oid, self.node_id, self.agent_addr,
                                 sv.total_size)
         return True
+
+    def _next_ingest_name(self) -> str:
+        """Ingest-file name unique ACROSS pid namespaces: containerized
+        workers share the store dir while each believes it is pid 1, so
+        the pid alone collides — the random worker_id disambiguates
+        (r5 advisor finding). Seq is lock-guarded: puts run on arbitrary
+        user threads and the io loop concurrently."""
+        with self._fastpath_lock:
+            self._ingest_seq += 1
+            seq = self._ingest_seq
+        return f"ingest-{self.worker_id.hex()[:16]}-{os.getpid()}-{seq}"
 
     def _get_fastpath(self):
         """Connect the C sidecar client once (probing store_info on the
@@ -1177,12 +1191,10 @@ class CoreWorker:
 
         loop = asyncio.get_running_loop()
         if sdir:
-            with self._fastpath_lock:  # shared with user-thread fast puts
-                self._ingest_seq += 1
-                seq = self._ingest_seq
-            name = f"ingest-{os.getpid()}-{seq}"
+            name = self._next_ingest_name()
             path = os.path.join(sdir, name)
             flags = os.O_CREAT | os.O_RDWR | os.O_EXCL
+            wrote = False
             try:
                 # Big copies run OFF the io loop (a 1 GiB put must not
                 # stall RPC).
@@ -1190,20 +1202,17 @@ class CoreWorker:
                     await loop.run_in_executor(None, _write_at, path,
                                                flags)
                 else:
+                    # lint: allow-blocking(<=4MiB tmpfs write; executor hop costs more than the copy)
                     _write_at(path, flags)
-                await self.agent.call("store_ingest", oid, name,
-                                      sv.total_size, len(meta))
-                return
+                wrote = True
             except FileExistsError:
-                # A prior fast-path ingest COMMITTED but its response
-                # was lost: the object is already stored (puts are
-                # idempotent — a fresh oid can only collide with its own
-                # earlier attempt). Treat as success.
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
-                return
+                # O_EXCL lost a NAME collision: that file is another
+                # writer's in-flight payload — never unlink it, never
+                # claim success (r5 advisor: the old treat-as-success
+                # here silently lost objects). Names embed worker_id so
+                # this is near-impossible; fall through to create+seal.
+                logger.warning("ingest name collision on %s; using the "
+                               "create+seal path", name)
             except OSError:
                 # Write failed (e.g. tmpfs ENOSPC before the store could
                 # account/evict): clean up and fall through to the
@@ -1219,6 +1228,30 @@ class CoreWorker:
                 except OSError:
                     pass
                 raise
+            if wrote:
+                try:
+                    await self.agent.call("store_ingest", oid, name,
+                                          sv.total_size, len(meta))
+                    return
+                except RpcApplicationError as e:
+                    # FileExistsError FROM THE AGENT means the object is
+                    # already stored (a prior ingest committed but its
+                    # response was lost and the dedup entry aged out):
+                    # puts are idempotent — success. The agent already
+                    # unlinked our source file on its error path.
+                    if isinstance(e.remote_exc, FileExistsError):
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+                        return
+                    raise
+                except BaseException:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    raise
         path = await self.agent.call("store_create", oid, sv.total_size,
                                      len(meta))
         if total > 4 * 1024 * 1024:
@@ -1482,7 +1515,7 @@ class CoreWorker:
         except TypeError:
             cached = None
         if cached is not None:
-            return cached, False
+            return cached, cached in self._pending_exports
         blob = cloudpickle.dumps(func)
         func_id = hashlib.sha1(blob).digest()
         if func_id not in self._exported_funcs:
@@ -1496,12 +1529,15 @@ class CoreWorker:
                 # here would deadlock the loop. Export asynchronously —
                 # the EXECUTING worker's _load_function retries while
                 # the export is in flight (spec.fn_async_export).
+                self._pending_exports.add(func_id)
                 self._spawn(self._export_bg(func_id, put))
             else:
                 self._run(put).result()
             self._exported_funcs.add(func_id)
         else:
-            async_export = False
+            # Re-submission while a background export is still in
+            # flight must keep the executor-side retry window open.
+            async_export = func_id in self._pending_exports
         try:
             self._func_id_cache[func] = func_id
         except TypeError:
@@ -1544,6 +1580,8 @@ class CoreWorker:
             self._exported_funcs.discard(func_id)
             logger.warning("function export %s failed: %r (will retry "
                            "on next submission)", func_id.hex()[:12], e)
+        finally:
+            self._pending_exports.discard(func_id)
 
     # ------------------------------------------------------------------
     # task submission (owner side)

@@ -42,6 +42,23 @@ from ray_tpu.utils.config import GlobalConfig
 logger = get_logger("node_agent")
 
 
+def _pread_file(path: str, offset: int, length: int) -> bytes:
+    """Executor-side chunk read: data-plane copies stay off the io loop."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        return f.read(length)
+
+
+def _pwrite_file(path: str, data: bytes, offset: int) -> None:
+    """Executor-side chunk write (open-per-chunk is a tmpfs metadata op;
+    the multi-MB pwrite is the cost being moved off the loop)."""
+    fd = os.open(path, os.O_RDWR)
+    try:
+        os.pwrite(fd, data, offset)
+    finally:
+        os.close(fd)
+
+
 class _ExternalProc:
     """Process we did not spawn (the driver); liveness via kill(pid, 0)."""
 
@@ -1458,9 +1475,8 @@ class NodeAgent:
                     continue  # restored mid-read: serve from the store
             path, ds, ms = got
             try:
-                with open(path, "rb") as f:
-                    f.seek(offset)
-                    return f.read(length)
+                return await asyncio.get_running_loop().run_in_executor(
+                    None, _pread_file, path, offset, length)
             finally:
                 self.store.release(ObjectID(oid))
         raise KeyError(f"object not local: {ObjectID(oid)}")
@@ -1501,14 +1517,16 @@ class NodeAgent:
             # full of them (a plain store.create would fail forever).
             path = await self.store_create(oid, ds, ms)
             chunk = GlobalConfig.object_transfer_chunk_bytes
-            with open(path, "r+b") as f:
-                off = 0
-                while off < total:
-                    n = min(chunk, total - off)
-                    data = await peer.call("fetch_chunk", oid, off, n)
-                    f.seek(off)
-                    f.write(data)
-                    off += n
+            loop = asyncio.get_running_loop()
+            off = 0
+            while off < total:
+                n = min(chunk, total - off)
+                data = await peer.call("fetch_chunk", oid, off, n)
+                # Chunk-sized copies run off the loop (a multi-MB write
+                # would stall every RPC sharing it).
+                await loop.run_in_executor(None, _pwrite_file, path,
+                                           data, off)
+                off += n
             self.store.seal(o)
             ev = self._seal_waiters.pop(oid, None)
             if ev:
@@ -1545,14 +1563,15 @@ class NodeAgent:
             try:
                 total = ds + ms
                 chunk = GlobalConfig.object_transfer_chunk_bytes
-                with open(path, "rb") as f:
-                    off = 0
-                    while off < total:
-                        f.seek(off)
-                        data = f.read(min(chunk, total - off))
-                        await peer.call("receive_push_chunk", oid, off,
-                                        data)
-                        off += len(data)
+                loop = asyncio.get_running_loop()
+                off = 0
+                while off < total:
+                    data = await loop.run_in_executor(
+                        None, _pread_file, path, off,
+                        min(chunk, total - off))
+                    await peer.call("receive_push_chunk", oid, off,
+                                    data)
+                    off += len(data)
                 await peer.call("receive_push_end", oid)
             except BaseException:
                 # Never leave the receiver with an unsealed husk: it
@@ -1588,11 +1607,8 @@ class NodeAgent:
         path = self._push_rx.get(oid)
         if path is None:
             raise KeyError(f"no push in progress for {ObjectID(oid)}")
-        fd = os.open(path, os.O_RDWR)
-        try:
-            os.pwrite(fd, data, offset)
-        finally:
-            os.close(fd)
+        await asyncio.get_running_loop().run_in_executor(
+            None, _pwrite_file, path, data, offset)
 
     async def receive_push_end(self, oid: bytes) -> None:
         if self._push_rx.pop(oid, None) is None:
@@ -1679,6 +1695,7 @@ class NodeAgent:
                         if os.path.exists(path) \
                                 and os.path.getsize(path) > pre:
                             await asyncio.sleep(0.05)  # let it finish
+                            # lint: allow-blocking(bounded faulthandler tail read on tmpfs; diagnostics-only path)
                             with open(path) as f:
                                 f.seek(pre)
                                 text = f.read()
@@ -1734,6 +1751,7 @@ class NodeAgent:
             try:
                 asyncio.get_running_loop().remove_reader(
                     self._fastpath.notify_fd)
+                # lint: allow-blocking(shutdown path: sidecar stop must join its C threads before store teardown; the loop exits 0.2s later)
                 self._fastpath.stop()
             except Exception:
                 pass

@@ -46,6 +46,19 @@ class _LLMBatchWorker:
         self.top_k = top_k
         self.seed = seed
 
+    def _row_seed(self, ids) -> int:
+        """Per-row sampling seed derived from the prompt CONTENT plus
+        the configured seed: identical across reruns AND across
+        batch-size changes, with distinct Gumbel streams for distinct
+        prompts (r5 advisor — seed+index-within-batch reused streams
+        across batches and shifted them when batch_size changed)."""
+        import hashlib
+        h = hashlib.blake2b(digest_size=8)
+        h.update(int(self.seed).to_bytes(8, "little", signed=True))
+        for t in ids:
+            h.update(int(t).to_bytes(4, "little", signed=True))
+        return int.from_bytes(h.digest(), "little")
+
     def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
         import numpy as np
 
@@ -55,15 +68,14 @@ class _LLMBatchWorker:
         # Submit the WHOLE batch first: the engine's continuous batching
         # decodes all of them concurrently across KV slots.
         streams = []
-        for i, prompt in enumerate(prompts):
+        for prompt in prompts:
             if isinstance(prompt, np.ndarray):
                 prompt = prompt.tolist()
             ids = _encode_prompt(self.cfg, prompt)
             streams.append(self.engine.submit(
                 ids, self.max_tokens, temperature=self.temperature,
                 top_k=self.top_k,
-                # Deterministic per-row seed: reruns reproduce.
-                seed=self.seed + i if self.temperature > 0 else 0))
+                seed=self._row_seed(ids) if self.temperature > 0 else 0))
         outs = []
         for q in streams:
             toks: list = []

@@ -1,0 +1,133 @@
+"""graftlint driver.
+
+    python -m ray_tpu.tools.lint [paths...] [options]
+
+With no paths: lints the framework control plane (ray_tpu/core,
+ray_tpu/serve, ray_tpu/data), checks the store wire schema against
+csrc/store_server.cc, and cross-checks RPC call sites across all of
+ray_tpu/. Exits 1 when findings remain after annotations + allowlist.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ray_tpu.tools.lint import event_loop, leaks, locks, rpc_signatures, \
+    wire_schema
+from ray_tpu.tools.lint.common import (Finding, SourceFile, iter_py_files,
+                                       load_allowlist, load_source)
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+_DEFAULT_PATHS = ["ray_tpu/core", "ray_tpu/serve", "ray_tpu/data"]
+_DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(__file__),
+                                  "allowlist.txt")
+
+
+def _load(paths: List[str], root: str) -> List[SourceFile]:
+    out = []
+    for p in iter_py_files(paths):
+        sf = load_source(p, root)
+        if sf is not None:
+            out.append(sf)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ray_tpu.tools.lint",
+        description="framework-aware static analysis for ray_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs for the AST passes "
+                         f"(default: {' '.join(_DEFAULT_PATHS)})")
+    ap.add_argument("--root", default=_REPO_ROOT,
+                    help="repo root for relative finding paths")
+    ap.add_argument("--store-py", default=None,
+                    help="Python side of the store wire schema "
+                         "(default: ray_tpu/core/object_store.py)")
+    ap.add_argument("--store-cc", default=None,
+                    help="C side of the store wire schema "
+                         "(default: csrc/store_server.cc)")
+    ap.add_argument("--no-wire", action="store_true",
+                    help="skip the wire-schema drift pass")
+    ap.add_argument("--rpc-root", default=None,
+                    help="root scanned for RPC call sites/handlers "
+                         "(default: ray_tpu/); 'none' disables")
+    ap.add_argument("--allowlist", default=_DEFAULT_ALLOWLIST,
+                    help="committed allowlist file")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        print("event-loop  blocking calls inside async def")
+        print("locks       await-under-lock + lock-order inversions")
+        print("wire        Python<->C store schema + RPC arity drift")
+        print("leaks       un-awaited coroutines, orphaned tasks")
+        return 0
+
+    root = os.path.abspath(args.root)
+    explicit_paths = bool(args.paths)
+    paths = [p if os.path.isabs(p) else os.path.join(root, p)
+             for p in (args.paths or _DEFAULT_PATHS)]
+    files = _load(paths, root)
+    allow = load_allowlist(args.allowlist)
+
+    findings: List[Finding] = []
+    findings += event_loop.run(files)
+    findings += locks.run(files)
+    findings += leaks.run(files)
+
+    if not args.no_wire:
+        py_path = args.store_py or os.path.join(
+            root, "ray_tpu", "core", "object_store.py")
+        cc_path = args.store_cc or os.path.join(
+            root, "csrc", "store_server.cc")
+        if os.path.exists(py_path) and os.path.exists(cc_path):
+            findings += wire_schema.run(
+                py_path, cc_path,
+                os.path.relpath(py_path, root).replace(os.sep, "/"),
+                os.path.relpath(cc_path, root).replace(os.sep, "/"))
+        elif args.store_py or args.store_cc or not explicit_paths:
+            findings.append(Finding(
+                "<wire>", 1, wire_schema.RULE, "error",
+                f"wire schema sources missing: {py_path} / {cc_path}"))
+
+    if args.rpc_root != "none":
+        rpc_root = args.rpc_root or os.path.join(root, "ray_tpu")
+        rpc_files = _load([rpc_root], root)
+        handlers = rpc_signatures.collect_handlers(rpc_files)
+        if handlers:
+            findings += rpc_signatures.check_call_sites(rpc_files,
+                                                        handlers)
+        elif not explicit_paths:
+            findings.append(Finding(
+                "<rpc>", 1, rpc_signatures.RULE_UNKNOWN, "error",
+                "no registered RPC handler classes found under "
+                f"{rpc_root} (register_object(self) sites)"))
+
+    kept = [f for f in findings if not allow.allows(f)]
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    if args.json:
+        print(json.dumps([f.__dict__ for f in kept], indent=2))
+    else:
+        for f in kept:
+            print(f.render())
+        for path, rule, qual, reason in allow.unused():
+            print(f"note: unused allowlist entry {path}:{rule}:{qual} "
+                  f"({reason})", file=sys.stderr)
+        n_suppressed = len(findings) - len(kept)
+        print(f"graftlint: {len(kept)} finding(s) "
+              f"({n_suppressed} allowlisted) across {len(files)} files",
+              file=sys.stderr)
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,320 @@
+"""Pass 3a — Python<->C wire-schema drift.
+
+The fast-path store protocol is hand-duplicated: opcode numbers and the
+event-journal layout live in `ray_tpu/core/object_store.py`
+(`FastStoreClient.OP_*`, `StoreSidecar.EVENT_SIZE` + `drain()` slicing)
+and again in `csrc/store_server.cc` (`kOp*`, `struct Event`, the drain
+packing, and the request/response framing). A one-sided edit ships a
+protocol break that only surfaces as runtime corruption, so this pass
+re-derives both sides (AST for Python, regex-over-constexpr for C — no
+clang needed) and fails on any mismatch in opcode values, field order,
+offsets, or widths.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.tools.lint.common import Finding
+
+RULE = "wire-drift"
+
+_C_TYPE_WIDTHS = {"uint8_t": 1, "int8_t": 1, "char": 1, "uint16_t": 2,
+                  "int16_t": 2, "uint32_t": 4, "int32_t": 4, "int": 4,
+                  "uint64_t": 8, "int64_t": 8}
+
+
+# --------------------------------------------------------------------------
+# Python side.
+# --------------------------------------------------------------------------
+class PySchema:
+    def __init__(self) -> None:
+        self.opcodes: Dict[str, int] = {}      # INGEST -> 1
+        self.event_size: Optional[int] = None
+        # field name -> (offset, width) parsed from drain()'s slicing
+        self.event_fields: Dict[str, Tuple[int, int]] = {}
+
+
+def parse_python(path: str) -> Tuple[PySchema, List[str]]:
+    errors: List[str] = []
+    schema = PySchema()
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    client = sidecar = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            if node.name == "FastStoreClient":
+                client = node
+            elif node.name == "StoreSidecar":
+                sidecar = node
+    if client is None:
+        errors.append("class FastStoreClient not found")
+    else:
+        for stmt in client.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            targets = stmt.targets[0]
+            names = ([t.id for t in targets.elts]
+                     if isinstance(targets, ast.Tuple)
+                     else [targets.id] if isinstance(targets, ast.Name)
+                     else [])
+            values = (stmt.value.elts if isinstance(stmt.value, ast.Tuple)
+                      else [stmt.value])
+            for name, val in zip(names, values):
+                if name.startswith("OP_") and isinstance(val, ast.Constant):
+                    schema.opcodes[name[3:]] = val.value
+        if not schema.opcodes:
+            errors.append("FastStoreClient defines no OP_* constants")
+    if sidecar is None:
+        errors.append("class StoreSidecar not found")
+    else:
+        for stmt in sidecar.body:
+            if (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "EVENT_SIZE"):
+                # may be `29` or an arithmetic expression
+                try:
+                    schema.event_size = int(
+                        ast.literal_eval(_fold(stmt.value)))
+                except Exception:
+                    errors.append("cannot evaluate StoreSidecar.EVENT_SIZE")
+        drain = next((n for n in sidecar.body
+                      if isinstance(n, ast.FunctionDef)
+                      and n.name == "drain"), None)
+        if drain is None:
+            errors.append("StoreSidecar.drain not found")
+        else:
+            slices = _rec_slices(drain)
+            if slices:
+                schema.event_fields = slices
+            else:
+                errors.append("drain(): no rec[...] slicing found")
+    return schema, errors
+
+
+def _fold(node: ast.AST) -> ast.AST:
+    return node
+
+
+def _rec_slices(drain: ast.FunctionDef) -> Dict[str, Tuple[int, int]]:
+    """Read drain()'s `rec[a:b]` subscripts: offset 0 byte = op, the
+    first multi-byte slice = oid, the second = size."""
+    pairs: List[Tuple[int, int]] = []
+    for node in ast.walk(drain):
+        if not (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "rec"):
+            continue
+        sl = node.slice
+        if (isinstance(sl, ast.Slice)
+                and isinstance(sl.lower, ast.Constant)
+                and isinstance(sl.upper, ast.Constant)):
+            pairs.append((sl.lower.value, sl.upper.value))
+    pairs.sort()
+    fields: Dict[str, Tuple[int, int]] = {"op": (0, 1)}
+    if len(pairs) >= 1:
+        fields["oid"] = (pairs[0][0], pairs[0][1] - pairs[0][0])
+    if len(pairs) >= 2:
+        fields["size"] = (pairs[1][0], pairs[1][1] - pairs[1][0])
+    return fields
+
+
+# --------------------------------------------------------------------------
+# C side (clang-free: targeted regexes over the constexpr block, the
+# Event struct, the drain packing, and the framing code).
+# --------------------------------------------------------------------------
+class CSchema:
+    def __init__(self) -> None:
+        self.opcodes: Dict[str, int] = {}      # Ingest -> 1
+        self.id_size: Optional[int] = None
+        self.event_fields: List[Tuple[str, int]] = []  # (name, width)
+        self.drain_offsets: Dict[str, int] = {}        # oid/size offsets
+        self.drain_stride: Optional[int] = None
+        self.req_header: Optional[int] = None          # client buffer
+        self.server_reads: List[int] = []              # header widths
+        self.server_writes: List[int] = []             # response widths
+        self.client_reads: List[int] = []              # response widths
+
+
+def parse_c(path: str) -> Tuple[CSchema, List[str]]:
+    errors: List[str] = []
+    schema = CSchema()
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+
+    m = re.search(r"constexpr\s+int\s+kIdSize\s*=\s*(\d+)\s*;", text)
+    if m:
+        schema.id_size = int(m.group(1))
+    else:
+        errors.append("kIdSize constexpr not found")
+
+    for m in re.finditer(r"kOp([A-Za-z0-9_]+)\s*=\s*(\d+)", text):
+        schema.opcodes[m.group(1)] = int(m.group(2))
+    if not schema.opcodes:
+        errors.append("no kOp* constants found")
+
+    consts = {"kIdSize": schema.id_size or 0}
+
+    def ev(expr: str) -> Optional[int]:
+        expr = expr.strip()
+        for k, v in consts.items():
+            expr = expr.replace(k, str(v))
+        if not re.fullmatch(r"[\d\s+*()-]+", expr):
+            return None
+        try:
+            return int(eval(expr))  # noqa: S307 — digits/ops only
+        except Exception:
+            return None
+
+    m = re.search(r"struct\s+Event\s*\{(.*?)\};", text, re.S)
+    if not m:
+        errors.append("struct Event not found")
+    else:
+        for fm in re.finditer(
+                r"^\s*([A-Za-z_][A-Za-z0-9_]*)\s+([A-Za-z_][A-Za-z0-9_]*)"
+                r"(?:\[([^\]]+)\])?\s*;", m.group(1), re.M):
+            ctype, name, arr = fm.group(1), fm.group(2), fm.group(3)
+            width = _C_TYPE_WIDTHS.get(ctype)
+            if width is None:
+                errors.append(f"struct Event: unknown type {ctype}")
+                continue
+            if arr is not None:
+                count = ev(arr)
+                if count is None:
+                    errors.append(f"struct Event: cannot size {name}[{arr}]")
+                    continue
+                width *= count
+            schema.event_fields.append((name, width))
+
+    # Drain packing: buf[n] = op; memcpy(buf + n + OFF, e.FIELD, W)
+    for fm in re.finditer(
+            r"memcpy\(buf\s*\+\s*n\s*\+\s*(\d+)\s*,\s*&?e\.(\w+)\s*,"
+            r"\s*([A-Za-z0-9_]+)\)", text):
+        schema.drain_offsets[fm.group(2)] = int(fm.group(1))
+    m = re.search(r"n\s*\+=\s*(\d+)\s*;", text)
+    if m:
+        schema.drain_stride = int(m.group(1))
+
+    # Client request header buffer: char req[1 + kIdSize + 8 + 8 + 2]
+    m = re.search(r"char\s+req\[([^\]]+)\]", text)
+    if m:
+        schema.req_header = ev(m.group(1))
+    else:
+        errors.append("client request buffer (char req[...]) not found")
+
+    # Server-side header reads / response writes, client response reads.
+    server_region = _region(text, "ConnLoop")
+    client_region = _region(text, "store_client_request")
+    schema.server_reads = _io_widths(server_region, "ReadFull", ev)[:5]
+    schema.server_writes = _io_widths(server_region, "WriteFull", ev)[:4]
+    schema.client_reads = _io_widths(client_region, "ReadFull", ev)[:4]
+    return schema, errors
+
+
+def _region(text: str, fn_name: str) -> str:
+    """The body of the (column-0) function definition of `fn_name`: from
+    the definition line to the next closing brace at column 0."""
+    m = re.search(r"^[A-Za-z_][\w:<> ]*\*?\s*\b" + fn_name + r"\s*\(",
+                  text, re.M)
+    if m is None:
+        return ""
+    end = text.find("\n}", m.start())
+    return text[m.start():end + 2] if end >= 0 else text[m.start():]
+
+
+def _io_widths(region: str, fn: str, ev) -> List[int]:
+    out = []
+    for m in re.finditer(fn + r"\(fd,\s*[^,]+,\s*([A-Za-z0-9_ +*-]+)\)",
+                         region):
+        w = ev(m.group(1))
+        if w is not None:
+            out.append(w)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Cross-checks.
+# --------------------------------------------------------------------------
+def run(py_path: str, cc_path: str, py_rel: str, cc_rel: str
+        ) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def err(path: str, msg: str) -> None:
+        findings.append(Finding(path, 1, RULE, "error", msg))
+
+    py, py_errors = parse_python(py_path)
+    cc, cc_errors = parse_c(cc_path)
+    for e in py_errors:
+        err(py_rel, e)
+    for e in cc_errors:
+        err(cc_rel, e)
+    if py_errors or cc_errors:
+        return findings
+
+    # 1. Opcode tables: same names, same values.
+    py_ops = {k.lower(): v for k, v in py.opcodes.items()}
+    cc_ops = {k.lower(): v for k, v in cc.opcodes.items()}
+    for name in sorted(set(py_ops) | set(cc_ops)):
+        if name not in py_ops:
+            err(py_rel, f"opcode {name!r} exists in C (kOp*) but has no "
+                        f"OP_* constant in FastStoreClient")
+        elif name not in cc_ops:
+            err(cc_rel, f"opcode {name!r} exists in Python (OP_*) but "
+                        f"has no kOp* constant")
+        elif py_ops[name] != cc_ops[name]:
+            err(py_rel, f"opcode {name!r} drift: Python OP_={py_ops[name]}"
+                        f" vs C kOp={cc_ops[name]}")
+
+    # 2. Object-id width: C kIdSize vs the drain() oid slice.
+    oid = py.event_fields.get("oid")
+    if oid is not None and cc.id_size is not None \
+            and oid[1] != cc.id_size:
+        err(py_rel, f"oid width drift: drain() slices {oid[1]} bytes but "
+                    f"C kIdSize={cc.id_size}")
+
+    # 3. Event record: packed struct width == EVENT_SIZE == drain stride,
+    #    field offsets agree with Python's slicing.
+    packed = sum(w for _, w in cc.event_fields)
+    if py.event_size is not None and packed != py.event_size:
+        err(cc_rel, f"event record drift: C struct Event packs to "
+                    f"{packed} bytes but Python EVENT_SIZE="
+                    f"{py.event_size}")
+    if cc.drain_stride is not None and py.event_size is not None \
+            and cc.drain_stride != py.event_size:
+        err(cc_rel, f"event record drift: C drain stride "
+                    f"{cc.drain_stride} != Python EVENT_SIZE "
+                    f"{py.event_size}")
+    offset = 0
+    c_offsets = {}
+    for name, width in cc.event_fields:
+        c_offsets[name] = (offset, width)
+        offset += width
+    for fname, (py_off, py_w) in py.event_fields.items():
+        c = c_offsets.get(fname)
+        if c is None:
+            continue
+        if (py_off, py_w) != c:
+            err(py_rel, f"event field {fname!r} drift: Python reads "
+                        f"[{py_off}:{py_off + py_w}] but C packs it at "
+                        f"offset {c[0]} width {c[1]}")
+    for fname, (c_off, c_w) in c_offsets.items():
+        # every drain memcpy offset must match the packed layout
+        d = cc.drain_offsets.get(fname)
+        if d is not None and d != c_off:
+            err(cc_rel, f"drain packing drift: field {fname!r} copied at "
+                        f"offset {d} but struct layout says {c_off}")
+
+    # 4. Request/response framing: client layout vs server reads.
+    if cc.req_header is not None and cc.server_reads:
+        if cc.req_header != sum(cc.server_reads):
+            err(cc_rel, f"request header drift: client sends "
+                        f"{cc.req_header} bytes, server reads "
+                        f"{sum(cc.server_reads)}")
+    if cc.server_writes and cc.client_reads \
+            and cc.server_writes != cc.client_reads:
+        err(cc_rel, f"response framing drift: server writes widths "
+                    f"{cc.server_writes}, client reads "
+                    f"{cc.client_reads}")
+    return findings

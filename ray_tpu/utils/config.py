@@ -92,6 +92,7 @@ _flag("max_task_retries_default", int, 3, "Default retries for retriable tasks."
 _flag("actor_max_restarts_default", int, 0, "Default actor restarts.")
 _flag("lineage_pinning_enabled", bool, True, "Pin lineage for object reconstruction.")
 _flag("gcs_storage_path", str, "", "Controller durable-state path: empty = in-memory; *.db/*.sqlite = sqlite store (put on shared storage for head failover); else a pickle snapshot file (the reference's Redis-backed GCS fault tolerance analogue).")
+_flag("gcs_storage_allow_empty_start", bool, False, "Override: let the controller start with EMPTY in-memory state when the configured gcs_storage_path fails to open. Default off — an unopenable durable store fails fast instead of silently 'restoring' an empty cluster (the reference's redis-backed GCS does the same).")
 
 # --- worker isolation (reference: src/ray/common/cgroup2/) ---
 _flag("cgroup_isolation", bool, True, "Put dedicated actor workers with memory/CPU requests into cgroup v2 scopes when the unified hierarchy is writable.")

@@ -1,0 +1,509 @@
+"""graftlint (ray_tpu.tools.lint) — pass fixtures + CLI gate, and
+regression tests for the four r5 advisor fixes that shipped with it
+(ingest-name pid-namespace collision, async function-export race,
+controller durable-store fail-fast, content-derived batch-LLM seeds).
+
+Every negative fixture here is the drift the linter exists to catch:
+if a test starts failing because the repo itself regressed (not the
+linter), fix the repo — the CI lint stage gates on the same passes.
+"""
+
+import asyncio
+import os
+import textwrap
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from ray_tpu.tools.lint import (event_loop, leaks, locks, rpc_signatures,
+                                wire_schema)
+from ray_tpu.tools.lint.__main__ import main as lint_main
+from ray_tpu.tools.lint.common import load_allowlist, load_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STORE_PY = os.path.join(REPO, "ray_tpu", "core", "object_store.py")
+STORE_CC = os.path.join(REPO, "csrc", "store_server.cc")
+
+
+def _sf(tmp_path, source, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    sf = load_source(str(p), str(tmp_path))
+    assert sf is not None, "fixture failed to parse"
+    return sf
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# pass 1 — event-loop safety
+# ---------------------------------------------------------------------------
+
+def test_blocking_sleep_in_async_def_flagged(tmp_path):
+    sf = _sf(tmp_path, """
+        import time
+
+        async def poll():
+            time.sleep(0.5)
+    """)
+    fs = event_loop.run([sf])
+    assert _rules(fs) == ["blocking-call"]
+    assert fs[0].qualname == "poll"
+    assert "asyncio.sleep" in fs[0].message
+
+
+def test_sync_defs_and_executor_bodies_not_flagged(tmp_path):
+    # time.sleep in a plain def, and in a nested def handed to an
+    # executor, both run OFF the loop — neither may be flagged.
+    sf = _sf(tmp_path, """
+        import time
+
+        def worker():
+            time.sleep(1)
+            open("/tmp/x")
+
+        async def dispatch(loop):
+            def _copy():
+                time.sleep(1)
+                open("/tmp/y")
+            await loop.run_in_executor(None, _copy)
+    """)
+    assert event_loop.run([sf]) == []
+
+
+def test_file_io_api_get_and_fastpath_flagged(tmp_path):
+    sf = _sf(tmp_path, """
+        from ray_tpu import api
+
+        class W:
+            async def handler(self, ref):
+                open("/etc/hosts")
+                api.get(ref)
+                self._fastpath.ingest(b"oid", "name", 1, 0)
+    """)
+    fs = event_loop.run([sf])
+    assert _rules(fs) == ["blocking-call"] * 3
+    assert {f.qualname for f in fs} == {"W.handler"}
+
+
+def test_result_on_concurrent_future_flagged(tmp_path):
+    sf = _sf(tmp_path, """
+        class W:
+            async def handler(self):
+                fut = self._run(self.refresh())
+                fut.result()
+                self._run(self.refresh()).result()
+                done, _ = await self.wait_all()
+                done.result()  # plain var: not a known producer
+    """)
+    fs = event_loop.run([sf])
+    assert _rules(fs) == ["blocking-call"] * 2
+    assert all(".result()" in f.message for f in fs)
+
+
+def test_allow_blocking_annotation_needs_reason(tmp_path):
+    sf = _sf(tmp_path, """
+        import time
+
+        async def tap():
+            time.sleep(0.01)  # lint: allow-blocking(bounded tmpfs tap, measured 40us)
+
+        async def sloppy():
+            # lint: allow-blocking()
+            time.sleep(0.01)
+    """)
+    fs = event_loop.run([sf])
+    # tap: suppressed. sloppy: empty reason => bad-annotation AND the
+    # blocking finding stays.
+    assert _rules(fs) == ["bad-annotation", "blocking-call"]
+    assert fs[1].qualname == "sloppy" or fs[0].qualname == "sloppy"
+
+
+def test_allow_comment_on_own_line_covers_next_line(tmp_path):
+    sf = _sf(tmp_path, """
+        import time
+
+        async def tap():
+            # lint: allow-blocking(diagnostics-only; bounded)
+            time.sleep(0.01)
+    """)
+    assert event_loop.run([sf]) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 2 — lock discipline
+# ---------------------------------------------------------------------------
+
+def test_await_rpc_under_lock_flagged(tmp_path):
+    sf = _sf(tmp_path, """
+        class A:
+            async def refresh(self):
+                async with self._table_lock:
+                    await self.agent.call("pull_object", b"oid")
+    """)
+    fs = locks.run([sf])
+    assert _rules(fs) == ["await-under-lock"]
+    assert "self._table_lock" in fs[0].message
+
+
+def test_await_outside_lock_and_local_await_under_lock_clean(tmp_path):
+    sf = _sf(tmp_path, """
+        class A:
+            async def refresh(self):
+                await self.agent.call("pull_object", b"oid")
+                async with self._table_lock:
+                    await self._rebuild_index()
+    """)
+    assert locks.run([sf]) == []
+
+
+def test_lock_order_inversion_flagged(tmp_path):
+    sf = _sf(tmp_path, """
+        class A:
+            async def forward(self):
+                async with self._a_lock:
+                    async with self._b_lock:
+                        self.n += 1
+
+            async def backward(self):
+                async with self._b_lock:
+                    async with self._a_lock:
+                        self.n -= 1
+    """)
+    fs = locks.run([sf])
+    assert _rules(fs) == ["lock-order"]
+    assert "self._a_lock" in fs[0].message \
+        and "self._b_lock" in fs[0].message
+
+
+def test_consistent_lock_order_clean(tmp_path):
+    sf = _sf(tmp_path, """
+        class A:
+            async def forward(self):
+                async with self._a_lock:
+                    async with self._b_lock:
+                        self.n += 1
+
+            async def also_forward(self):
+                async with self._a_lock:
+                    async with self._b_lock:
+                        self.n -= 1
+    """)
+    assert locks.run([sf]) == []
+
+
+def test_sync_functions_contribute_lock_order_edges(tmp_path):
+    # threading locks deadlock the same way: one sync side of the
+    # inversion must still be seen.
+    sf = _sf(tmp_path, """
+        class A:
+            def sync_side(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            async def async_side(self):
+                async with self._b_lock:
+                    async with self._a_lock:
+                        pass
+    """)
+    assert _rules(locks.run([sf])) == ["lock-order"]
+
+
+# ---------------------------------------------------------------------------
+# pass 4 — leak patterns
+# ---------------------------------------------------------------------------
+
+def test_unawaited_coroutine_and_orphan_task_flagged(tmp_path):
+    sf = _sf(tmp_path, """
+        import asyncio
+
+        class A:
+            async def work(self):
+                return 1
+
+            def kick(self):
+                self.work()
+
+            async def ok(self):
+                await self.work()
+                asyncio.create_task(self.work())
+                t = asyncio.create_task(self.work())
+                t.add_done_callback(print)
+    """)
+    fs = leaks.run([sf])
+    assert _rules(fs) == ["orphan-task", "unawaited-coroutine"]
+
+
+def test_spawned_and_awaited_coroutines_clean(tmp_path):
+    sf = _sf(tmp_path, """
+        from ray_tpu.utils.aio import spawn
+
+        class A:
+            async def work(self):
+                return 1
+
+            async def ok(self):
+                await self.work()
+                self._spawn(self.work())
+                spawn(self.work())
+    """)
+    assert leaks.run([sf]) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 3a — wire-schema drift (Python store client vs C store server)
+# ---------------------------------------------------------------------------
+
+def test_wire_schema_repo_in_sync():
+    fs = wire_schema.run(STORE_PY, STORE_CC, "py", "cc")
+    assert fs == [], [f.render() for f in fs]
+
+
+def _mutated_cc(tmp_path, old, new):
+    with open(STORE_CC) as f:
+        text = f.read()
+    assert old in text, f"fixture drifted: {old!r} not in store_server.cc"
+    p = tmp_path / "store_server.cc"
+    p.write_text(text.replace(old, new, 1))
+    return str(p)
+
+
+def test_wire_schema_detects_opcode_flip(tmp_path):
+    cc = _mutated_cc(tmp_path, "kOpDelete = 4", "kOpDelete = 6")
+    fs = wire_schema.run(STORE_PY, cc, "py", "cc")
+    assert fs and all(f.rule == "wire-drift" for f in fs)
+    assert any("delete" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_wire_schema_detects_struct_width_change(tmp_path):
+    cc = _mutated_cc(tmp_path, "uint64_t size;", "uint32_t size;")
+    fs = wire_schema.run(STORE_PY, cc, "py", "cc")
+    assert fs and all(f.rule == "wire-drift" for f in fs)
+    assert any("size" in f.message.lower() for f in fs), \
+        [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# pass 3b — RPC handler-signature drift
+# ---------------------------------------------------------------------------
+
+# NOTE: indented to match the 8-space base of the in-test fragments it
+# is concatenated with, so the shared textwrap.dedent strips both evenly.
+_RPC_HANDLERS = """
+        class Widget:
+            def __init__(self, server):
+                server.register_object(self)
+
+            async def frob(self, a, b, flag=False):
+                return a
+
+            async def _private(self, x):
+                return x
+"""
+
+
+def test_rpc_call_sites_bind_against_handlers(tmp_path):
+    sf = _sf(tmp_path, _RPC_HANDLERS + """
+        async def good(client):
+            await client.call("frob", 1, 2)
+            await client.call("frob", 1, b=2, flag=True)
+            await client.call("frob", 1, 2, timeout=5.0)
+    """)
+    handlers = rpc_signatures.collect_handlers([sf])
+    assert set(handlers) == {"frob"}  # public async defs only
+    assert rpc_signatures.check_call_sites([sf], handlers) == []
+
+
+def test_rpc_arity_and_unknown_method_flagged(tmp_path):
+    sf = _sf(tmp_path, _RPC_HANDLERS + """
+        async def bad(client):
+            await client.call("frob", 1, 2, 3, 4)
+            await client.call("frob", 1, 2, wrong=1)
+            await client.call("frob", 1)
+            await client.call("defrobulate", 1)
+    """)
+    handlers = rpc_signatures.collect_handlers([sf])
+    fs = rpc_signatures.check_call_sites([sf], handlers)
+    assert _rules(fs) == ["rpc-arity-drift"] * 3 + ["rpc-unknown-method"]
+
+
+def test_rpc_register_prefix_honored(tmp_path):
+    sf = _sf(tmp_path, """
+        class Gadget:
+            def __init__(self, server):
+                server.register_object(self, prefix="g_")
+
+            async def spin(self, rpm):
+                return rpm
+
+        async def call_it(client):
+            await client.call("g_spin", 100)
+            await client.call("spin", 100)
+    """)
+    handlers = rpc_signatures.collect_handlers([sf])
+    assert set(handlers) == {"g_spin"}
+    fs = rpc_signatures.check_call_sites([sf], handlers)
+    assert _rules(fs) == ["rpc-unknown-method"]  # unprefixed name
+
+
+def test_rpc_repo_handlers_collected():
+    files = []
+    for base in ("core",):
+        d = os.path.join(REPO, "ray_tpu", base)
+        for name in os.listdir(d):
+            if name.endswith(".py"):
+                sf = load_source(os.path.join(d, name), REPO)
+                if sf:
+                    files.append(sf)
+    handlers = rpc_signatures.collect_handlers(files)
+    # The three registered control-plane objects must be discovered.
+    classes = {sig.cls for sigs in handlers.values() for sig in sigs}
+    assert {"Controller", "NodeAgent", "CoreWorker"} <= classes
+
+
+# ---------------------------------------------------------------------------
+# driver / CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_clean_on_repo(capsys):
+    # THE gate: the framework control plane lints clean with the
+    # committed allowlist (same invocation as the ci.sh stage).
+    rc = lint_main([])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_cli_nonzero_on_bad_fixture(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    rc = lint_main([str(p), "--root", str(tmp_path), "--no-wire",
+                    "--rpc-root", "none", "--allowlist", ""])
+    assert rc == 1
+    assert "blocking-call" in capsys.readouterr().out
+
+
+def test_cli_allowlist_suppresses_by_qualname(tmp_path, capsys):
+    p = tmp_path / "mod.py"
+    p.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    al = tmp_path / "allow.txt"
+    al.write_text("mod.py : blocking-call : f : deliberate test fixture\n")
+    rc = lint_main([str(p), "--root", str(tmp_path), "--no-wire",
+                    "--rpc-root", "none", "--allowlist", str(al)])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_allowlist_reason_required(tmp_path):
+    al = tmp_path / "allow.txt"
+    al.write_text("mod.py : blocking-call : f :\n")
+    with pytest.raises(SystemExit):
+        load_allowlist(str(al))
+
+
+# ---------------------------------------------------------------------------
+# r5 advisor regression tests (the fixes that shipped with this linter)
+# ---------------------------------------------------------------------------
+
+def test_ingest_names_unique_across_pid_namespaces():
+    # Containerized workers each think they are pid 1: the name must
+    # disambiguate on worker_id, not just (pid, seq).
+    from ray_tpu.core.core_worker import CoreWorker
+
+    def fake(hexid):
+        return SimpleNamespace(_fastpath_lock=threading.Lock(),
+                               _ingest_seq=0,
+                               worker_id=SimpleNamespace(hex=lambda: hexid))
+
+    a, b = fake("aa" * 20), fake("bb" * 20)
+    na = CoreWorker._next_ingest_name(a)
+    nb = CoreWorker._next_ingest_name(b)
+    assert na != nb          # same pid + same seq, different workers
+    assert "aa" * 8 in na and "bb" * 8 in nb
+    assert CoreWorker._next_ingest_name(a) != na  # seq advances
+
+
+def test_pending_export_reopens_retry_window():
+    # Re-submitting a cached function while its background export is
+    # still in flight must keep async_export=True so executors keep
+    # their retry window (the r5 async function-export race).
+    from ray_tpu.core.core_worker import CoreWorker
+
+    def func():
+        return 1
+
+    fid = b"\x01" * 20
+    w = SimpleNamespace(_func_id_cache={func: fid},
+                        _pending_exports={fid})
+    assert CoreWorker._export_function(w, func) == (fid, True)
+    w._pending_exports.clear()
+    assert CoreWorker._export_function(w, func) == (fid, False)
+
+
+def test_export_bg_failure_unmarks_and_clears_pending():
+    from ray_tpu.core.core_worker import CoreWorker
+
+    fid = b"\x02" * 20
+    w = SimpleNamespace(_exported_funcs={fid}, _pending_exports={fid})
+
+    async def boom():
+        raise RuntimeError("kv down")
+
+    asyncio.run(CoreWorker._export_bg(w, fid, boom()))
+    assert fid not in w._pending_exports   # retry window closed
+    assert fid not in w._exported_funcs    # next submission re-exports
+
+    w = SimpleNamespace(_exported_funcs={fid}, _pending_exports={fid})
+
+    async def ok():
+        return None
+
+    asyncio.run(CoreWorker._export_bg(w, fid, ok()))
+    assert fid not in w._pending_exports
+    assert fid in w._exported_funcs
+
+
+def test_controller_fails_fast_on_unopenable_durable_store(tmp_path):
+    from ray_tpu.core.controller import Controller
+    from ray_tpu.core.store_client import MemoryStoreClient
+    from ray_tpu.utils.config import GlobalConfig
+
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    bad = str(blocker / "state.db")  # parent is a file: cannot open
+    try:
+        GlobalConfig.initialize({"gcs_storage_path": bad})
+        with pytest.raises(RuntimeError, match="failed to open"):
+            Controller()
+        # Explicit override: degrade to empty in-memory state, loudly.
+        GlobalConfig.initialize({"gcs_storage_allow_empty_start": True})
+        c = Controller()
+        assert isinstance(c._store, MemoryStoreClient)
+    finally:
+        GlobalConfig._overrides.clear()
+        GlobalConfig._cache.clear()
+
+
+def test_batch_llm_row_seed_content_derived():
+    # Seeds derive from (configured seed, prompt token ids) — NOT the
+    # row's position in its batch — so reruns reproduce regardless of
+    # batch_size and distinct prompts get distinct Gumbel streams.
+    from ray_tpu.data.llm import _LLMBatchWorker
+
+    seed = _LLMBatchWorker._row_seed
+    w = SimpleNamespace(seed=7)
+    rows = [[5], [6, 7], [8, 9, 10], [11]]
+
+    one_batch = [seed(w, r) for r in rows]
+    rebatched = [seed(w, r) for r in rows[:2]] + \
+                [seed(w, r) for r in rows[2:]]
+    assert one_batch == rebatched            # batch-size independent
+    assert len(set(one_batch)) == len(rows)  # distinct streams per row
+    assert seed(w, [5]) == one_batch[0]      # rerun-stable
+    assert seed(SimpleNamespace(seed=8), [5]) != one_batch[0]
+    # numpy token dtypes hash identically to Python ints
+    np = pytest.importorskip("numpy")
+    assert seed(w, list(np.asarray([6, 7], np.int32))) == one_batch[1]
