@@ -1,7 +1,7 @@
 # Developer entry points. CI runs ci.sh (which includes `make lint`'s
 # invocation verbatim); these targets are the pieces, runnable alone.
 
-.PHONY: lint test fast native native-test
+.PHONY: lint test fast native native-test bench-core
 
 # graftlint: framework-aware static analysis (event-loop safety, lock
 # discipline, Python<->C wire-schema drift, RPC signature drift, leaks).
@@ -20,3 +20,8 @@ native:
 
 native-test:
 	$(MAKE) -C csrc test
+
+# Regenerate the committed control-plane benchmark numbers in-repo
+# (one JSON line per metric; compare vs_ref against BASELINE.md).
+bench-core:
+	JAX_PLATFORMS=cpu python bench_core.py | tee BENCH_CORE.json

@@ -204,6 +204,14 @@ def main() -> None:
         bench_get_calls()
         bench_put_gigabytes()
         bench_pg_create_removal()
+        print(json.dumps({
+            "metric": "_meta",
+            "note": "python bench_core.py (make bench-core regenerates "
+                    "BENCH_CORE.json); run-to-run variance on small CI "
+                    "VMs is +/-25%; put_gigabytes is bound by the raw "
+                    "tmpfs write ceiling",
+            "host_cores": os.cpu_count(),
+        }), flush=True)
     finally:
         ray_tpu.shutdown()
 

@@ -215,8 +215,9 @@ class CoreWorker:
         # Task-event buffer, flushed to the controller in batches
         # (reference: task_event_buffer.cc -> gcs_task_manager.cc).
         # Guarded: submit runs on user threads, completion on the io loop.
-        self._task_events: List[dict] = []
+        self._task_events: List[tuple] = []
         self._task_events_lock = threading.Lock()
+        self._task_events_cap: Optional[int] = None  # lazy config read
         # Lease-cached dispatch state, per scheduling class.
         self._class_queues: Dict[tuple, list] = {}
         self._class_pumps: Dict[tuple, asyncio.Task] = {}
@@ -246,6 +247,23 @@ class CoreWorker:
         # wake the io loop ONCE per burst instead of once per call.
         self._spawn_buf: deque = deque()
         self._spawn_scheduled = False
+        # graftrpc dispatch plane (csrc/rpc_core.cc): native transport for
+        # push_task_batch between co-located workers. The asyncio RpcServer
+        # stays the control plane. None = off / native lib unavailable.
+        self._graft = None
+        self._graft_path = ""
+        self._graft_channels: Dict[Any, Any] = {}    # peer addr -> channel
+        self._graft_chan_by_conn: Dict[int, Any] = {}
+        self._graft_interns: Dict[int, dict] = {}    # serve side, per conn
+        self._graft_no: set = set()  # peers with no graft listener
+        self._graft_dialing: Dict[Any, Any] = {}  # single-flight discovery
+        # Actor-dispatch wakeup coalescing: user threads append specs to
+        # _actor_push_buf directly (GIL-atomic) and poke the drainer once
+        # per burst — no per-call coroutine/Task/Future on the hot path.
+        self._dispatch_dirty: deque = deque()
+        self._dispatch_scheduled = False
+        self._owned_drop_buf: deque = deque()
+        self._owned_drop_scheduled = False
         # func -> exported func_id (pickle a function once per process,
         # like the reference's RemoteFunction._remote; reference:
         # python/ray/remote_function.py:314).
@@ -293,6 +311,26 @@ class CoreWorker:
         while self._spawn_buf:
             spawn(self._spawn_buf.popleft())
 
+    def _poke_dispatch(self, actor_id: bytes) -> None:
+        """Ensure a flusher will run for this actor's push buffer. Same
+        lost-wakeup-free shape as _spawn: append BEFORE the flag check,
+        drain clears the flag BEFORE draining."""
+        self._dispatch_dirty.append(actor_id)
+        if not self._dispatch_scheduled:
+            self._dispatch_scheduled = True
+            try:
+                self._loop.call_soon_threadsafe(self._drain_dispatch)
+            except RuntimeError:  # loop shut down mid-call
+                self._dispatch_scheduled = False
+
+    def _drain_dispatch(self) -> None:
+        self._dispatch_scheduled = False
+        while self._dispatch_dirty:
+            actor_id = self._dispatch_dirty.popleft()
+            if actor_id not in self._actor_flushing:
+                self._actor_flushing.add(actor_id)
+                spawn(self._flush_actor_pushes(actor_id))
+
     async def _async_init(self) -> None:
         # Same-host agent RPC rides a unix socket when one is available
         # (spawned workers get it via env; the driver probes below).
@@ -318,6 +356,22 @@ class CoreWorker:
                                       self.port)
         self.node_id = reply["node_id"]
         self.store_dir = reply["store_dir"]
+        if GlobalConfig.graftrpc:
+            try:
+                from ray_tpu.core._native import graftrpc
+                if graftrpc.available():
+                    path = os.path.join(
+                        self.session_dir,
+                        f"graft-{self.worker_id.binary().hex()[:12]}.sock")
+                    ep = graftrpc.GraftEndpoint(
+                        asyncio.get_running_loop(), path)
+                    ep.on_frame = self._on_graft_frame
+                    ep.on_close = self._on_graft_close
+                    self._graft = ep
+                    self._graft_path = path
+            except Exception as e:
+                logger.debug("graftrpc dispatch plane unavailable: %r", e)
+                self._graft = None
         spawn(self._task_event_flusher())
         if self.mode == "driver" and GlobalConfig.log_to_driver:
             # Worker prints stream to this driver (reference:
@@ -392,21 +446,16 @@ class CoreWorker:
     def _record_task_event(self, task_id: bytes, name: str,
                            event: str, trace_id: bytes = b"",
                            parent_span: bytes = b"") -> None:
-        import time as _time
+        # Submission hot path (two events per task): append the raw
+        # tuple; dict shaping + hex conversion happen at flush time.
+        cap = self._task_events_cap
+        if cap is None:
+            cap = self._task_events_cap = \
+                GlobalConfig.task_events_batch_size
         with self._task_events_lock:
-            rec = {
-                "task_id": task_id.hex(), "name": name, "event": event,
-                "ts": _time.time(), "owner": self.worker_id.hex()[:8]}
-            if trace_id:
-                # Span model: span id == task id; these two fields make
-                # the cross-process task TREE reconstructable from the
-                # event stream (reference: tracing_helper.py spans).
-                rec["trace_id"] = trace_id.hex()
-                rec["parent_span"] = parent_span.hex() \
-                    if parent_span else ""
-            self._task_events.append(rec)
-            full = (len(self._task_events)
-                    >= GlobalConfig.task_events_batch_size)
+            self._task_events.append(
+                (task_id, name, event, time.time(), trace_id, parent_span))
+            full = len(self._task_events) >= cap
         if full:
             self._flush_task_events()
 
@@ -425,8 +474,22 @@ class CoreWorker:
     def _flush_task_events(self) -> None:
         with self._task_events_lock:
             batch, self._task_events = self._task_events, []
-        if batch:
-            self._spawn(self._send_task_events(batch))
+        if not batch:
+            return
+        owner = self.worker_id.hex()[:8]
+        out = []
+        for task_id, name, event, ts, trace_id, parent_span in batch:
+            rec = {"task_id": task_id.hex(), "name": name, "event": event,
+                   "ts": ts, "owner": owner}
+            if trace_id:
+                # Span model: span id == task id; these two fields make
+                # the cross-process task TREE reconstructable from the
+                # event stream (reference: tracing_helper.py spans).
+                rec["trace_id"] = trace_id.hex()
+                rec["parent_span"] = parent_span.hex() \
+                    if parent_span else ""
+            out.append(rec)
+        self._spawn(self._send_task_events(out))
 
     async def _send_task_events(self, batch: list) -> None:
         try:
@@ -502,13 +565,42 @@ class CoreWorker:
             owner = ref.owner_addr
             try:
                 if owner is None or tuple(owner) == self.address:
-                    self._spawn(self._on_owned_ref_dropped(k))
+                    # Owned drops are BATCHED: a burst of GC'd refs pays
+                    # one loop wakeup and zero Tasks for the common
+                    # no-contained-refs case (same shape as _spawn).
+                    self._owned_drop_buf.append(k)
+                    if not self._owned_drop_scheduled:
+                        self._owned_drop_scheduled = True
+                        self._loop.call_soon_threadsafe(
+                            self._drain_owned_drops)
                 else:
                     self._spawn(self._notify_remove_borrow(tuple(owner), k))
             except RuntimeError:
-                pass  # interpreter/loop shutdown
+                self._owned_drop_scheduled = False  # loop shut down
         else:
             self._local_ref_counts[k] = n - 1
+
+    def _drain_owned_drops(self) -> None:
+        self._owned_drop_scheduled = False
+        while self._owned_drop_buf:
+            oid = self._owned_drop_buf.popleft()
+            e = self.objects.get(oid)
+            if e is None or oid in self._local_ref_counts \
+                    or e.borrow_refs > 0:
+                continue
+            if e.contained:
+                # Contained-ref borrows need awaits; rare path.
+                spawn(self._maybe_free(oid))
+                continue
+            self.objects.pop(oid, None)
+            self.free_device_object(oid)
+            self._drop_map_cache(oid)
+            if e.locations:
+                for node_id, addr in e.locations:
+                    self._free_buf.setdefault(tuple(addr), []).append(oid)
+                if not self._free_flush_scheduled:
+                    self._free_flush_scheduled = True
+                    self._loop.call_soon(self._flush_frees)
 
     def on_ref_deserialized(self, ref: ObjectRef) -> None:
         k = ref.binary()
@@ -1264,19 +1356,72 @@ class CoreWorker:
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
             ) -> List[Any]:
-        if len(refs) == 1:
-            out = self._try_fast_get(refs[0])
-            if out is not self._FAST_MISS:
-                return [out]
-
-        async def _gather():
-            return await asyncio.gather(
-                *[self.get_async(r, timeout) for r in refs])
+        # Per-ref sync fast path: only the misses pay the event-loop
+        # round-trip (a multi-ref get over READY local objects costs no
+        # loop hop at all).
+        out = [self._try_fast_get(r) for r in refs]
+        miss = [i for i, v in enumerate(out) if v is self._FAST_MISS]
+        if not miss:
+            return out
 
         try:
-            return list(self._run(_gather()).result())
+            got = self._run(self._bulk_get(refs, miss, timeout)).result()
         except asyncio.TimeoutError:
             raise GetTimeoutError(f"get timed out after {timeout}s")
+        for i, v in zip(miss, got):
+            out[i] = v
+        return out
+
+    async def _bulk_get(self, refs: Sequence[ObjectRef], miss: List[int],
+                        timeout: Optional[float]) -> List[Any]:
+        """Resolve the fast-path misses of a bulk get.
+
+        Self-owned refs resolve via local entry events, so one coroutine
+        awaits them in sequence (a Task per ref costs more than the waits
+        themselves on a big batch); work that does real I/O — borrowed
+        refs and store fetches — still runs concurrently. All waits share
+        one deadline, matching the old gather's per-call timeout start.
+        """
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+        borrowed = [i for i in miss if not self._is_self_owned(refs[i])]
+        btask = asyncio.gather(
+            *[self.get_async(refs[i], timeout) for i in borrowed]) \
+            if borrowed else None
+        results: Dict[int, Any] = {}
+        fetch: List[tuple] = []  # (index, store-fetch coroutine)
+        try:
+            for i in miss:
+                ref = refs[i]
+                if not self._is_self_owned(ref):
+                    continue
+                rem = None if deadline is None else \
+                    max(0.0, deadline - loop.time())
+                oid = ref.binary()
+                e = await self._wait_entry_ready(oid, rem)
+                if e.state == ERROR:
+                    raise e.error
+                if e.inline is not None:
+                    results[i] = serialization.deserialize(
+                        e.inline[0], e.inline[1])
+                else:
+                    fetch.append((i, self._get_from_store(oid, e)))
+            if fetch:
+                idxs = [i for i, _ in fetch]
+                got = await asyncio.gather(*[c for _, c in fetch])
+                fetch = []
+                for i, v in zip(idxs, got):
+                    results[i] = v
+        except BaseException:
+            if btask is not None:
+                btask.cancel()
+            for _, c in fetch:
+                c.close()
+            raise
+        if btask is not None:
+            for i, v in zip(borrowed, await btask):
+                results[i] = v
+        return [results[i] for i in miss]
 
     def _try_fast_get(self, ref: ObjectRef):
         """Synchronous get for the common local case — a READY
@@ -2224,9 +2369,11 @@ class CoreWorker:
         self._ensure_actor_sub()
         streaming = num_returns == "streaming"
         task_id = TaskID.random()
+        tid = task_id.binary()
+        wid = self.worker_id.binary()
         held: List[ObjectRef] = []
         spec = TaskSpec(
-            task_id=task_id.binary(),
+            task_id=tid,
             name=f"{handle._name}.{method}",
             func_id=b"",
             args=self._serialize_args(args, kwargs, held),
@@ -2234,17 +2381,16 @@ class CoreWorker:
             streaming=streaming,
             resources={},
             owner_addr=self.address,
-            owner_worker_id=self.worker_id.binary(),
+            owner_worker_id=wid,
             actor_id=actor_id,
             method_name=method,
             seqno=-1,  # assigned at push time (incarnation-aware)
-            caller_id=self.worker_id.binary(),
+            caller_id=wid,
             max_retries=handle._max_task_retries,
         )
-        spec.trace_id, spec.parent_span = \
-            self._trace_for_new_task(task_id.binary())
-        self._task_arg_refs[task_id.binary()] = held
-        self._record_task_event(task_id.binary(), spec.name, "submitted",
+        spec.trace_id, spec.parent_span = self._trace_for_new_task(tid)
+        self._task_arg_refs[tid] = held
+        self._record_task_event(tid, spec.name, "submitted",
                                 spec.trace_id, spec.parent_span)
         if streaming:
             from ray_tpu.core.ref import ObjectRefGenerator
@@ -2258,7 +2404,13 @@ class CoreWorker:
             self.add_local_ref(ref)
             self._entry(oid.binary(), create=True)
             refs.append(ref)
-        self._spawn(self._submit_actor_and_track(spec))
+        # Hot path: no per-call coroutine/Task/Future. Append straight to
+        # the per-actor push buffer (GIL-atomic from this user thread) and
+        # poke the dispatch drainer — one loop wakeup per burst. A None
+        # future means completion is settled through the return-ref
+        # entries themselves (_settle_spec_error / _process_task_reply).
+        self._actor_push_buf.setdefault(actor_id, []).append((spec, None))
+        self._poke_dispatch(actor_id)
         return refs[0] if num_returns == 1 else refs
 
     async def _submit_actor_and_track(self, spec: TaskSpec) -> None:
@@ -2338,6 +2490,109 @@ class CoreWorker:
         self._actor_clients[actor_id] = (addr, client, incarnation)
         return client
 
+    # ------------------------------------------------------------------
+    # graftrpc dispatch plane (native hot path for push_task_batch)
+    # ------------------------------------------------------------------
+    async def graft_sock(self) -> str:
+        """Dispatch-plane discovery (control-plane RPC): path of this
+        worker's graftrpc listener, '' when the native plane is off."""
+        return self._graft_path if self._graft is not None else ""
+
+    def _on_graft_frame(self, conn: int, op: int, flags: int, chan: int,
+                        seq: int, payload: bytes) -> None:
+        from ray_tpu.core._native import graftrpc
+        if op == graftrpc.OP_REPLY:
+            ch = self._graft_chan_by_conn.get(conn)
+            if ch is not None:
+                ch.on_reply(seq, flags, payload)
+        elif op == graftrpc.OP_CALL:
+            spawn(self._serve_graft_call(conn, seq, payload))
+        elif op == graftrpc.OP_INTERN:
+            graftrpc.intern_frame_apply(
+                payload, self._graft_interns.setdefault(conn, {}))
+
+    def _on_graft_close(self, conn: int) -> None:
+        self._graft_interns.pop(conn, None)
+        ch = self._graft_chan_by_conn.pop(conn, None)
+        if ch is not None:
+            # In-flight calls surface as a retriable transport loss; the
+            # actor retry loop re-resolves the client and assigns FRESH
+            # seqnos (replaying old ones would park the peer's gate).
+            ch.fail(RpcConnectionLost("graftrpc connection lost"))
+            for addr, cached in list(self._graft_channels.items()):
+                if cached is ch:
+                    self._graft_channels.pop(addr, None)
+
+    async def _graft_channel_for(self, client: RpcClient):
+        """Dispatch-plane channel to the peer behind `client`, or None
+        when the plane is off locally, the peer has no listener (cached
+        negatively), or discovery/connect fails. Discovery is
+        single-flight per address: a burst of concurrent batches shares
+        one dial instead of opening one connection each."""
+        if self._graft is None:
+            return None
+        addr = client._address if isinstance(client._address, str) \
+            else tuple(client._address)
+        ch = self._graft_channels.get(addr)
+        if ch is not None and not ch.closed:
+            return ch
+        if addr in self._graft_no:
+            return None
+        fut = self._graft_dialing.get(addr)
+        if fut is None:
+            fut = spawn(self._graft_dial(client, addr))
+            self._graft_dialing[addr] = fut
+            fut.add_done_callback(
+                lambda _f, _a=addr: self._graft_dialing.pop(_a, None))
+        try:
+            return await asyncio.shield(fut)
+        except Exception:
+            return None
+
+    async def _graft_dial(self, client: RpcClient, addr):
+        try:
+            path = await client.call("graft_sock")
+        except RpcApplicationError:
+            path = ""  # older peer: no such method
+        except Exception:
+            return None  # transient: let the asyncio path surface it
+        if not path or not os.path.exists(path):
+            self._graft_no.add(addr)
+            return None
+        from ray_tpu.core._native import graftrpc
+        try:
+            conn = self._graft.connect(path)
+        except graftrpc.GraftError:
+            self._graft_no.add(addr)
+            return None
+        ch = graftrpc.GraftChannel(self._graft, conn)
+        self._graft_channels[addr] = ch
+        self._graft_chan_by_conn[conn] = ch
+        return ch
+
+    async def _serve_graft_call(self, conn: int, seq: int,
+                                payload: bytes) -> None:
+        """Executor side of one OP_CALL frame. Failures that escape the
+        per-task reply shape (codec drift, unknown intern id) come back
+        as a whole-batch FLAG_ERR — the caller fails the batch hard
+        rather than retrying what may have half-executed."""
+        from ray_tpu.core._native import graftrpc
+        try:
+            specs = graftrpc.decode_call(
+                payload, self._graft_interns.get(conn, {}))
+            replies = await self._serve_specs(specs)
+            out = graftrpc.encode_replies(replies)
+            flags = 0
+        except BaseException as e:  # noqa: BLE001 — crosses the wire
+            try:
+                out = pickle.dumps(repr(e), protocol=5)
+            except Exception:
+                out = pickle.dumps("<unrepresentable dispatch error>",
+                                   protocol=5)
+            flags = graftrpc.FLAG_ERR
+        if self._graft is not None:
+            self._graft.send(conn, graftrpc.OP_REPLY, seq, out, flags=flags)
+
     # Max actor tasks coalesced into one push_task_batch RPC. Batching
     # amortizes the per-RPC cost (framing, dedup, task spawn, reply hop)
     # across a burst of submissions to the same actor — the reference's
@@ -2347,14 +2602,39 @@ class CoreWorker:
 
     async def _submit_actor_with_retries(self, spec: TaskSpec) -> None:
         """Join the per-actor push batch; the flusher coalesces every
-        submission buffered while the previous RPC was in flight."""
+        submission buffered while the previous RPC was in flight.
+        (Streaming tasks still ride this awaited path; plain actor calls
+        enqueue directly from submit_actor_task with no future.)"""
         fut = asyncio.get_running_loop().create_future()
-        buf = self._actor_push_buf.setdefault(spec.actor_id, [])
-        buf.append((spec, fut))
-        if spec.actor_id not in self._actor_flushing:
-            self._actor_flushing.add(spec.actor_id)
-            spawn(self._flush_actor_pushes(spec.actor_id))
+        self._actor_push_buf.setdefault(spec.actor_id, []).append((spec, fut))
+        self._poke_dispatch(spec.actor_id)
         await fut
+
+    def _spec_settled(self, spec: TaskSpec, fut) -> bool:
+        """Whether a buffered submission already completed/failed. The
+        taskless hot path (fut=None) is settled exactly when its arg-ref
+        entry is gone — _release_arg_refs pops it on every settle path."""
+        if fut is not None:
+            return fut.done()
+        return spec.task_id not in self._task_arg_refs
+
+    def _settle_spec_error(self, spec: TaskSpec, fut,
+                           err: Exception) -> None:
+        """Fail a buffered/batched actor submission. With a future, the
+        awaiting _submit_actor_and_track wrapper does the bookkeeping;
+        without one (direct hot path) the return refs are marked here."""
+        if fut is not None:
+            if not fut.done():
+                fut.set_exception(err)
+            return
+        if spec.task_id not in self._task_arg_refs:
+            return  # already settled
+        self._record_task_event(spec.task_id, spec.name, "failed",
+                                spec.trace_id, spec.parent_span)
+        for i in range(spec.num_returns):
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+            self._mark_error(oid.binary(), err)
+        self._release_arg_refs(spec)
 
     # In-flight batch RPCs per actor. Multiple must be allowed: an async
     # actor method may PARK awaiting a later call (signal patterns) — a
@@ -2411,11 +2691,10 @@ class CoreWorker:
                                                                batch)
                 except BaseException as e:
                     sem.release()
-                    for _, fut in batch:
-                        if not fut.done():
-                            fut.set_exception(
-                                e if isinstance(e, Exception)
-                                else WorkerCrashedError(repr(e)))
+                    err = e if isinstance(e, Exception) \
+                        else WorkerCrashedError(repr(e))
+                    for spec, fut in batch:
+                        self._settle_spec_error(spec, fut, err)
                     continue
                 if prepared is None:
                     sem.release()
@@ -2423,35 +2702,58 @@ class CoreWorker:
                 task = spawn(self._send_actor_batch(actor_id, *prepared))
                 task.add_done_callback(lambda _t, _s=sem: _s.release())
         finally:
-            # No awaits between the loop's empty check and this discard
-            # (same loop thread), so a submission racing the exit always
-            # sees the flag cleared and spawns a fresh flusher.
             self._actor_flushing.discard(actor_id)
+            # Submissions land from user threads: one may have appended
+            # after this loop's empty check while the flushing flag was
+            # still set (its poke found us "running"). Re-poke so it is
+            # never stranded.
+            if buf:
+                self._poke_dispatch(actor_id)
 
     async def _prepare_actor_batch(self, actor_id: bytes, batch: list):
-        """Resolve the client + assign seqnos + pickle, in order.
-        Returns (client, live, blobs) or None if nothing left."""
+        """Resolve the client + assign seqnos, in order. Returns
+        (client, live) or None if nothing left. Wire encoding is
+        deferred to the send (the graft path never pickles full specs)."""
         from ray_tpu.core.common import TaskCancelledError
         live = []
         for spec, fut in batch:
-            if spec.task_id in self._cancelled and not fut.done():
-                fut.set_exception(
-                    TaskCancelledError(f"task {spec.name} cancelled"))
-            elif not fut.done():
+            if self._spec_settled(spec, fut):
+                continue
+            if spec.task_id in self._cancelled:
+                self._settle_spec_error(spec, fut, TaskCancelledError(
+                    f"task {spec.name} cancelled"))
+            else:
                 live.append((spec, fut))
         if not live:
             return None
         client = await self._actor_client(actor_id)
-        blobs = []
         for spec, _ in live:
             spec.seqno = self._actor_seq_out.get(actor_id, 0)
             self._actor_seq_out[actor_id] = spec.seqno + 1
             self._task_exec_addr[spec.task_id] = tuple(client._address)
-            blobs.append(pickle.dumps(spec, protocol=5))
-        return client, live, blobs
+        return client, live
 
-    async def _send_actor_batch(self, actor_id: bytes, client, live: list,
-                                blobs: list) -> None:
+    async def _push_batch_transport(self, actor_id: bytes, client,
+                                    live: list) -> list:
+        """One push attempt: the graftrpc dispatch plane when available,
+        the asyncio control-plane RPC otherwise. A GraftSendError means
+        the frame never hit the wire, so falling back WITHIN the attempt
+        cannot double-execute; any post-send loss surfaces as
+        RpcConnectionLost and rides the caller's retry loop (which
+        refreshes the client and assigns fresh seqnos)."""
+        specs = [spec for spec, _ in live]
+        chan = await self._graft_channel_for(client)
+        if chan is not None:
+            from ray_tpu.core._native.graftrpc import GraftSendError
+            try:
+                return await chan.call_batch(specs)
+            except GraftSendError:
+                pass
+        blobs = [pickle.dumps(spec, protocol=5) for spec in specs]
+        return await client.call("push_task_batch", blobs)
+
+    async def _send_actor_batch(self, actor_id: bytes, client,
+                                live: list) -> None:
         from ray_tpu.core.common import ActorDiedError, TaskCancelledError
         attempts = live[0][0].max_retries + 1
         last: Optional[BaseException] = None
@@ -2461,10 +2763,12 @@ class CoreWorker:
                 # drop cancelled members before re-pushing.
                 still = []
                 for spec, fut in live:
-                    if spec.task_id in self._cancelled and not fut.done():
-                        fut.set_exception(TaskCancelledError(
+                    if self._spec_settled(spec, fut):
+                        continue
+                    if spec.task_id in self._cancelled:
+                        self._settle_spec_error(spec, fut, TaskCancelledError(
                             f"task {spec.name} cancelled"))
-                    elif not fut.done():
+                    else:
                         still.append((spec, fut))
                 live = still
                 if not live:
@@ -2476,17 +2780,16 @@ class CoreWorker:
                     last = e if isinstance(e, Exception) else \
                         WorkerCrashedError(repr(e))
                     break
-                blobs = []
                 for spec, _ in live:
                     spec.seqno = self._actor_seq_out.get(actor_id, 0)
                     self._actor_seq_out[actor_id] = spec.seqno + 1
                     self._task_exec_addr[spec.task_id] = \
                         tuple(client._address)
-                    blobs.append(pickle.dumps(spec, protocol=5))
             t0 = time.monotonic()
             try:
                 try:
-                    replies = await client.call("push_task_batch", blobs)
+                    replies = await self._push_batch_transport(
+                        actor_id, client, live)
                 finally:
                     for spec, _ in live:
                         self._task_exec_addr.pop(spec.task_id, None)
@@ -2498,7 +2801,7 @@ class CoreWorker:
                 for (spec, fut), reply in zip(live, replies):
                     self._process_task_reply(spec, reply, client)
                     self._release_arg_refs(spec)
-                    if not fut.done():
+                    if fut is not None and not fut.done():
                         fut.set_result(None)
                 return
             except (RpcConnectionLost, ConnectionError, OSError) as e:
@@ -2516,9 +2819,8 @@ class CoreWorker:
             ActorDiedError(
                 f"actor task batch ({len(live)} tasks) failed after "
                 f"{attempts} attempts ({last!r})")
-        for _, fut in live:
-            if not fut.done():
-                fut.set_exception(err)
+        for spec, fut in live:
+            self._settle_spec_error(spec, fut, err)
 
     # ------------------------------------------------------------------
     # task execution (worker side)
@@ -2588,7 +2890,11 @@ class CoreWorker:
         method, no kwargs-side refs pending, not streaming, in seqno
         order, no builtin dispatch) additionally execute in ONE exec-pool
         hop — two thread switches per batch instead of per task."""
-        specs = [pickle.loads(b) for b in blobs]
+        return await self._serve_specs([pickle.loads(b) for b in blobs])
+
+    async def _serve_specs(self, specs: list) -> list:
+        """Shared executor entry for both transports: the asyncio
+        push_task_batch RPC and graftrpc OP_CALL frames."""
         if (self._is_actor_worker
                 and not getattr(self, "_actor_is_async", False)
                 and self._batch_fast_eligible(specs)):
@@ -3000,6 +3306,24 @@ class CoreWorker:
             self._exec_pool.shutdown(wait=False)
         except Exception:
             pass
+
+        async def _close_graft():
+            # Loop-affine close (sends happen only on this loop, so the
+            # reactor stop can never race one).
+            ep, self._graft = self._graft, None
+            if ep is not None:
+                ep.close()
+
+        if self._graft is not None:
+            try:
+                self._run(_close_graft()).result(timeout=2.0)
+            except Exception:
+                pass
+            try:
+                if self._graft_path:
+                    os.unlink(self._graft_path)
+            except OSError:
+                pass
 
         async def _cancel_all():
             for t in asyncio.all_tasks():

@@ -13,6 +13,35 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
+
+# Fast unique 16-byte IDs: one urandom seed per process plus a counter.
+# A getrandom(2) syscall per ID costs tens of microseconds on small VMs —
+# two orders of magnitude above the pack+concat — and ID generation sits
+# on the actor-call submission hot path. Collision safety: uniqueness
+# within a process comes from the counter; across processes from the
+# 8-byte random prefix (reseeded after fork).
+_rand_lock = threading.Lock()
+_rand_prefix = os.urandom(8)
+_rand_counter = int.from_bytes(os.urandom(4), "little")
+
+
+def _reseed() -> None:
+    global _rand_prefix, _rand_counter
+    _rand_prefix = os.urandom(8)
+    _rand_counter = int.from_bytes(os.urandom(4), "little")
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reseed)
+
+
+def _fast16() -> bytes:
+    global _rand_counter
+    with _rand_lock:
+        _rand_counter += 1
+        c = _rand_counter
+    return _rand_prefix + struct.pack("<Q", c & 0xFFFFFFFFFFFFFFFF)
 
 
 class BaseID:
@@ -26,6 +55,8 @@ class BaseID:
 
     @classmethod
     def random(cls):
+        if cls.SIZE == 16:
+            return cls(_fast16())
         return cls(os.urandom(cls.SIZE))
 
     @classmethod
@@ -90,8 +121,8 @@ class ObjectID(BaseID):
 
     @classmethod
     def from_put(cls) -> "ObjectID":
-        # Puts get a random "task" prefix with index 0xFFFFFFFF.
-        return cls(os.urandom(16) + b"\xff\xff\xff\xff")
+        # Puts get a unique "task" prefix with index 0xFFFFFFFF.
+        return cls(_fast16() + b"\xff\xff\xff\xff")
 
     def task_id(self) -> TaskID:
         return TaskID(self._bytes[:16])

@@ -1761,6 +1761,18 @@ class NodeAgent:
                     w.proc.terminate()
                 except Exception:
                     pass
+        # Workers' graftrpc listener sockets live in the session dir;
+        # terminated workers can't unlink their own, so sweep them here.
+        try:
+            import glob
+            for p in glob.glob(os.path.join(self.session_dir,
+                                            "graft-*.sock")):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        except Exception:
+            pass
         asyncio.get_running_loop().call_later(0.2, sys.exit, 0)
 
 

@@ -160,7 +160,11 @@ class ActorHandle:
         if item not in self._method_names:
             raise AttributeError(
                 f"Actor {self._name} has no method {item!r}")
-        return ActorMethod(self, item)
+        m = ActorMethod(self, item)
+        # Cache so repeated handle.method lookups skip __getattr__
+        # (__reduce__ pickles explicit state, so the cache never ships).
+        self.__dict__[item] = m
+        return m
 
     def __reduce__(self):
         return (_reconstruct_actor_handle, ({

@@ -117,6 +117,7 @@ class RpcServer:
         # instrumented_io_context + event_stats.cc): count, total/max time.
         self.event_stats: Dict[str, list] = {}  # method -> [n, total_s, max_s]
         self._long_poll_methods: set = set()
+        self._conns: set = set()  # live client writers (dropped on stop)
 
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
@@ -150,9 +151,16 @@ class RpcServer:
             srv.close()
             await srv.wait_closed()
         self._servers.clear()
+        # Closing the listeners only stops NEW connections; a stopped
+        # server must also drop established ones so clients see the loss
+        # (and fail their pending calls) instead of waiting forever.
+        for w in list(self._conns):
+            w.close()
+        self._conns.clear()
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
         try:
             while True:
                 try:
@@ -163,6 +171,7 @@ class RpcServer:
                 rid = msg[3] if len(msg) > 3 else None
                 spawn(self._dispatch(seqno, method, payload, writer, rid))
         finally:
+            self._conns.discard(writer)
             writer.close()
 
     async def _execute(self, method: str, payload: bytes) -> Tuple[int, bytes]:
@@ -273,8 +282,12 @@ class RpcClient:
         self._chaos = _chaos_table()
         self._rid_prefix = os.urandom(6).hex()
         self._rid_counter = 0
+        self._closed = False
+        self._reconnect_task: Optional[asyncio.Task] = None
 
     async def _ensure_connected(self) -> None:
+        if self._closed:
+            raise RpcConnectionLost(f"{self._address}: client closed")
         if self._writer is not None and not self._writer.is_closing():
             return
         async with self._lock:
@@ -302,11 +315,38 @@ class RpcClient:
                 else:
                     fut.set_exception(RpcApplicationError(pickle.loads(payload)))
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
-            self._fail_pending(RpcConnectionLost(str(self._address)))
+            self._on_conn_lost(RpcConnectionLost(str(self._address)))
         except asyncio.CancelledError:
             raise
         except Exception as e:  # pragma: no cover
-            self._fail_pending(RpcError(repr(e)))
+            # ANY recv-loop death is a transport loss to callers: wrap it
+            # as RpcConnectionLost so pending calls (and their retry
+            # loops) treat it as retriable rather than a hard RpcError.
+            self._on_conn_lost(
+                RpcConnectionLost(f"{self._address}: recv loop died: {e!r}"))
+
+    def _on_conn_lost(self, exc: Exception) -> None:
+        """Recv loop died: fail the in-flight calls and start dialing a
+        replacement connection in the background with jittered backoff,
+        so the next call finds a live transport instead of paying the
+        dial (callers that race it still reconnect lazily)."""
+        self._fail_pending(exc)
+        if not self._closed and self._reconnect_task is None:
+            self._reconnect_task = spawn(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        delay = 0.05
+        try:
+            while not self._closed:
+                try:
+                    await self._ensure_connected()
+                    return
+                except (RpcConnectionLost, ConnectionError, OSError):
+                    pass
+                await asyncio.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2, 2.0)
+        finally:
+            self._reconnect_task = None
 
     def _fail_pending(self, exc: Exception) -> None:
         if self._writer is not None:
@@ -337,7 +377,7 @@ class RpcClient:
         for attempt in range(self._max_retries + 1):
             if prob and random.random() < prob:
                 last = RpcConnectionLost(f"chaos-injected failure: {method}")
-                await asyncio.sleep(delay)
+                await asyncio.sleep(delay * (0.5 + random.random()))
                 delay = min(delay * 2, 1.0)
                 continue
             try:
@@ -360,11 +400,17 @@ class RpcClient:
                     asyncio.TimeoutError) as e:
                 last = e if isinstance(e, Exception) else RpcError(repr(e))
                 self._fail_pending(RpcConnectionLost(str(self._address)))
-                await asyncio.sleep(delay)
+                # Jittered exponential backoff: a burst of clients losing
+                # one server must not re-dial in lockstep.
+                await asyncio.sleep(delay * (0.5 + random.random()))
                 delay = min(delay * 2, 1.0)
         raise last or RpcError("rpc failed")
 
     async def close(self) -> None:
+        self._closed = True
+        if self._reconnect_task is not None:
+            self._reconnect_task.cancel()
+            self._reconnect_task = None
         if self._recv_task:
             self._recv_task.cancel()
             try:

@@ -54,6 +54,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(default: csrc/store_server.cc)")
     ap.add_argument("--no-wire", action="store_true",
                     help="skip the wire-schema drift pass")
+    ap.add_argument("--graft-py", default=None,
+                    help="Python side of the graftrpc frame schema "
+                         "(default: ray_tpu/core/_native/graftrpc.py)")
+    ap.add_argument("--graft-cc", default=None,
+                    help="C side of the graftrpc frame schema "
+                         "(default: csrc/rpc_core.cc)")
     ap.add_argument("--rpc-root", default=None,
                     help="root scanned for RPC call sites/handlers "
                          "(default: ray_tpu/); 'none' disables")
@@ -97,6 +103,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings.append(Finding(
                 "<wire>", 1, wire_schema.RULE, "error",
                 f"wire schema sources missing: {py_path} / {cc_path}"))
+        g_py = args.graft_py or os.path.join(
+            root, "ray_tpu", "core", "_native", "graftrpc.py")
+        g_cc = args.graft_cc or os.path.join(root, "csrc", "rpc_core.cc")
+        if os.path.exists(g_py) and os.path.exists(g_cc):
+            findings += wire_schema.run_graft(
+                g_py, g_cc,
+                os.path.relpath(g_py, root).replace(os.sep, "/"),
+                os.path.relpath(g_cc, root).replace(os.sep, "/"))
+        elif args.graft_py or args.graft_cc or not explicit_paths:
+            findings.append(Finding(
+                "<wire>", 1, wire_schema.RULE, "error",
+                f"graftrpc schema sources missing: {g_py} / {g_cc}"))
 
     if args.rpc_root != "none":
         rpc_root = args.rpc_root or os.path.join(root, "ray_tpu")

@@ -318,3 +318,240 @@ def run(py_path: str, cc_path: str, py_rel: str, cc_rel: str
                     f"{cc.server_writes}, client reads "
                     f"{cc.client_reads}")
     return findings
+
+
+# ==========================================================================
+# Pass 3c — graftrpc dispatch-plane schema drift.
+#
+# The graftrpc frame format is hand-duplicated the same way the store
+# protocol is: opcodes + header layout live in
+# `ray_tpu/core/_native/graftrpc.py` (OP_*, FRAME_HEADER_FIELDS,
+# FRAME_HEADER struct format, FRAME_HEADER_SIZE, MAX_FRAME) and again in
+# `csrc/rpc_core.cc` (kOp*, packed struct FrameHeader, kFrameHeaderSize,
+# kMaxFrame). Re-derive both sides and fail on any field-by-field
+# mismatch: name, width, order, total size, opcode value, frame cap.
+# ==========================================================================
+
+_STRUCT_CHAR_WIDTHS = {"b": 1, "B": 1, "h": 2, "H": 2, "i": 4, "I": 4,
+                       "l": 4, "L": 4, "q": 8, "Q": 8}
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    """Evaluate a literal int expression (constants, << + - * |)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        lhs, rhs = _const_int(node.left), _const_int(node.right)
+        if lhs is None or rhs is None:
+            return None
+        op = node.op
+        if isinstance(op, ast.LShift):
+            return lhs << rhs
+        if isinstance(op, ast.Add):
+            return lhs + rhs
+        if isinstance(op, ast.Sub):
+            return lhs - rhs
+        if isinstance(op, ast.Mult):
+            return lhs * rhs
+        if isinstance(op, ast.BitOr):
+            return lhs | rhs
+    return None
+
+
+class GraftPySchema:
+    def __init__(self) -> None:
+        self.opcodes: Dict[str, int] = {}            # CALL -> 1
+        self.header_fields: List[Tuple[str, int]] = []  # (name, width)
+        self.struct_widths: List[int] = []           # from "<BBHQ"
+        self.header_size: Optional[int] = None
+        self.max_frame: Optional[int] = None
+
+
+def parse_graft_py(path: str) -> Tuple[GraftPySchema, List[str]]:
+    errors: List[str] = []
+    schema = GraftPySchema()
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1 \
+                or not isinstance(stmt.targets[0], ast.Name):
+            continue
+        name, val = stmt.targets[0].id, stmt.value
+        if name.startswith("OP_"):
+            v = _const_int(val)
+            if v is None:
+                errors.append(f"cannot evaluate {name}")
+            else:
+                schema.opcodes[name[3:]] = v
+        elif name == "FRAME_HEADER_FIELDS":
+            if not isinstance(val, ast.Tuple):
+                errors.append("FRAME_HEADER_FIELDS is not a tuple")
+                continue
+            for el in val.elts:
+                if (isinstance(el, ast.Tuple) and len(el.elts) == 2
+                        and isinstance(el.elts[0], ast.Constant)):
+                    w = _const_int(el.elts[1])
+                    if w is None:
+                        errors.append("FRAME_HEADER_FIELDS: bad width")
+                        continue
+                    schema.header_fields.append((el.elts[0].value, w))
+                else:
+                    errors.append("FRAME_HEADER_FIELDS: bad entry shape")
+        elif name == "FRAME_HEADER":
+            # struct.Struct("<BBHQ") — read widths off the format chars.
+            if (isinstance(val, ast.Call) and val.args
+                    and isinstance(val.args[0], ast.Constant)):
+                fmt = val.args[0].value
+                for ch in str(fmt).lstrip("<>=!@"):
+                    w = _STRUCT_CHAR_WIDTHS.get(ch)
+                    if w is None:
+                        errors.append(
+                            f"FRAME_HEADER: unknown format char {ch!r}")
+                    else:
+                        schema.struct_widths.append(w)
+            else:
+                errors.append("FRAME_HEADER is not struct.Struct(<literal>)")
+        elif name == "FRAME_HEADER_SIZE":
+            schema.header_size = _const_int(val)
+            if schema.header_size is None:
+                errors.append("cannot evaluate FRAME_HEADER_SIZE")
+        elif name == "MAX_FRAME":
+            schema.max_frame = _const_int(val)
+            if schema.max_frame is None:
+                errors.append("cannot evaluate MAX_FRAME")
+    if not schema.opcodes:
+        errors.append("no OP_* constants found")
+    if not schema.header_fields:
+        errors.append("FRAME_HEADER_FIELDS not found")
+    if not schema.struct_widths:
+        errors.append("FRAME_HEADER struct format not found")
+    return schema, errors
+
+
+class GraftCSchema:
+    def __init__(self) -> None:
+        self.opcodes: Dict[str, int] = {}            # Call -> 1
+        self.header_fields: List[Tuple[str, int]] = []
+        self.header_size: Optional[int] = None
+        self.max_frame: Optional[int] = None
+
+
+def parse_graft_c(path: str) -> Tuple[GraftCSchema, List[str]]:
+    errors: List[str] = []
+    schema = GraftCSchema()
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+
+    for m in re.finditer(r"kOp([A-Za-z0-9_]+)\s*=\s*(\d+)", text):
+        schema.opcodes[m.group(1)] = int(m.group(2))
+    if not schema.opcodes:
+        errors.append("no kOp* constants found")
+
+    m = re.search(r"constexpr\s+int\s+kFrameHeaderSize\s*=\s*(\d+)\s*;",
+                  text)
+    if m:
+        schema.header_size = int(m.group(1))
+    else:
+        errors.append("kFrameHeaderSize constexpr not found")
+
+    m = re.search(r"kMaxFrame\s*=\s*([0-9a-zA-Z<< ]+?)\s*;", text)
+    if m:
+        expr = m.group(1).replace("u", "").strip()
+        if re.fullmatch(r"[\d\s<<]+", expr):
+            try:
+                schema.max_frame = int(eval(expr))  # noqa: S307 — digits/<<
+            except Exception:
+                errors.append(f"cannot evaluate kMaxFrame = {m.group(1)!r}")
+        else:
+            errors.append(f"cannot evaluate kMaxFrame = {m.group(1)!r}")
+    else:
+        errors.append("kMaxFrame not found")
+
+    m = re.search(r"struct\s+FrameHeader\s*\{(.*?)\};", text, re.S)
+    if not m:
+        errors.append("struct FrameHeader not found")
+    else:
+        for fm in re.finditer(
+                r"^\s*([A-Za-z_][A-Za-z0-9_]*)\s+([A-Za-z_][A-Za-z0-9_]*)"
+                r"\s*;", m.group(1), re.M):
+            ctype, fname = fm.group(1), fm.group(2)
+            width = _C_TYPE_WIDTHS.get(ctype)
+            if width is None:
+                errors.append(f"struct FrameHeader: unknown type {ctype}")
+                continue
+            schema.header_fields.append((fname, width))
+        if not schema.header_fields:
+            errors.append("struct FrameHeader has no parsable fields")
+    return schema, errors
+
+
+def run_graft(py_path: str, cc_path: str, py_rel: str, cc_rel: str
+              ) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def err(path: str, msg: str) -> None:
+        findings.append(Finding(path, 1, RULE, "error", msg))
+
+    py, py_errors = parse_graft_py(py_path)
+    cc, cc_errors = parse_graft_c(cc_path)
+    for e in py_errors:
+        err(py_rel, e)
+    for e in cc_errors:
+        err(cc_rel, e)
+    if py_errors or cc_errors:
+        return findings
+
+    # 1. Opcode tables: same names, same values.
+    py_ops = {k.lower(): v for k, v in py.opcodes.items()}
+    cc_ops = {k.lower(): v for k, v in cc.opcodes.items()}
+    for name in sorted(set(py_ops) | set(cc_ops)):
+        if name not in py_ops:
+            err(py_rel, f"graft opcode {name!r} exists in C (kOp*) but "
+                        f"has no OP_* constant in graftrpc.py")
+        elif name not in cc_ops:
+            err(cc_rel, f"graft opcode {name!r} exists in Python (OP_*) "
+                        f"but has no kOp* constant")
+        elif py_ops[name] != cc_ops[name]:
+            err(py_rel, f"graft opcode {name!r} drift: Python "
+                        f"OP_={py_ops[name]} vs C kOp={cc_ops[name]}")
+
+    # 2. Frame header: field-by-field name/width/order.
+    if len(py.header_fields) != len(cc.header_fields):
+        err(py_rel, f"frame header drift: Python declares "
+                    f"{len(py.header_fields)} fields, C struct has "
+                    f"{len(cc.header_fields)}")
+    for (pn, pw), (cn, cw) in zip(py.header_fields, cc.header_fields):
+        if pn != cn:
+            err(py_rel, f"frame header field order drift: Python has "
+                        f"{pn!r} where C has {cn!r}")
+        elif pw != cw:
+            err(py_rel, f"frame header field {pn!r} width drift: Python "
+                        f"{pw} vs C {cw}")
+
+    # 3. Struct format chars vs the declared field widths.
+    declared = [w for _, w in py.header_fields]
+    if py.struct_widths != declared:
+        err(py_rel, f"FRAME_HEADER format widths {py.struct_widths} != "
+                    f"FRAME_HEADER_FIELDS widths {declared}")
+
+    # 4. Header size: both constants and both layouts must agree.
+    psum = sum(w for _, w in py.header_fields)
+    csum = sum(w for _, w in cc.header_fields)
+    if py.header_size is not None and psum != py.header_size:
+        err(py_rel, f"FRAME_HEADER_FIELDS pack to {psum} bytes but "
+                    f"FRAME_HEADER_SIZE={py.header_size}")
+    if cc.header_size is not None and csum != cc.header_size:
+        err(cc_rel, f"struct FrameHeader packs to {csum} bytes but "
+                    f"kFrameHeaderSize={cc.header_size}")
+    if py.header_size is not None and cc.header_size is not None \
+            and py.header_size != cc.header_size:
+        err(py_rel, f"header size drift: FRAME_HEADER_SIZE="
+                    f"{py.header_size} vs kFrameHeaderSize="
+                    f"{cc.header_size}")
+
+    # 5. Frame cap.
+    if py.max_frame is not None and cc.max_frame is not None \
+            and py.max_frame != cc.max_frame:
+        err(py_rel, f"frame cap drift: MAX_FRAME={py.max_frame} vs "
+                    f"kMaxFrame={cc.max_frame}")
+    return findings

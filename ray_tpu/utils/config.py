@@ -73,6 +73,9 @@ _flag("object_transfer_chunk_bytes", int, 5 * 1024**2, "Chunk size for node-to-n
 _flag("max_concurrent_object_pulls", int, 4, "Active inbound object transfers per node; excess pulls queue by priority (reference: pull_manager.cc bandwidth-bounded active pulls).")
 _flag("object_spill_dir", str, "", "Directory for spilled objects (default: session dir).")
 
+# --- dispatch plane (graftrpc) ---
+_flag("graftrpc", bool, True, "Native dispatch plane for the actor-call hot path: co-located workers exchange push_task_batch frames over the C reactor (csrc/rpc_core.cc) instead of the asyncio RpcServer; falls back to the asyncio path when off or the native library is unavailable.")
+
 # --- scheduling ---
 _flag("scheduler_spread_threshold", float, 0.5, "Hybrid policy: pack below this utilization, then spread.")
 _flag("max_pending_lease_requests_per_class", int, 8, "Pipelined lease requests per scheduling class (aligned with worker_pool_max_idle_workers so steady-state bursts cause no worker churn).")

@@ -83,3 +83,82 @@ def test_streaming_generator_under_chaos(chaos_cluster):
 
     out = [ray_tpu.get(r, timeout=60) for r in gen.remote(20)]
     assert out == list(range(20))
+
+
+# ---------------------------------------------------------------------------
+# RpcClient transport recovery (no cluster; a bare server + client).
+# ---------------------------------------------------------------------------
+
+def test_rpc_client_recv_death_fails_pending_and_reconnects():
+    """Kill the server under a pending call: the call must surface
+    RpcConnectionLost, the client must redial in the background, and a
+    restarted server on the SAME port must serve the next call."""
+    import asyncio
+    from ray_tpu.core.rpc import RpcClient, RpcConnectionLost, RpcServer
+
+    async def scenario():
+        server = RpcServer("t")
+        gate = asyncio.Event()
+
+        async def park():
+            await gate.wait()
+            return "late"
+
+        async def ping():
+            return "pong"
+
+        server.register("park", park)
+        server.register("ping", ping)
+        port = await server.start_tcp()
+
+        client = RpcClient(("127.0.0.1", port), max_retries=0)
+        assert await client.call("ping") == "pong"
+
+        pending = asyncio.ensure_future(client.call("park"))
+        await asyncio.sleep(0.05)  # let the request hit the wire
+        await server.stop()  # drops every connection
+        with pytest.raises(RpcConnectionLost):
+            await asyncio.wait_for(pending, timeout=5)
+
+        # Same port, fresh server: the background reconnect (jittered
+        # backoff) or the lazy dial must carry the next call through.
+        server2 = RpcServer("t2")
+        server2.register("ping", ping)
+        await server2.start_tcp(port=port)
+        deadline = asyncio.get_running_loop().time() + 10
+        while True:
+            try:
+                assert await client.call("ping") == "pong"
+                break
+            except RpcConnectionLost:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+        await client.close()
+        await server2.stop()
+
+    asyncio.run(scenario())
+
+
+def test_rpc_client_recv_loop_death_wraps_as_connection_lost():
+    """A recv-loop death from a NON-socket error (corrupt frame) must
+    still fail pending calls with RpcConnectionLost (retriable), not a
+    bare RpcError."""
+    import asyncio
+    from ray_tpu.core.rpc import _LEN, RpcClient, RpcConnectionLost
+
+    async def scenario():
+        async def bad_server(reader, writer):
+            await reader.read(64)  # swallow the request
+            writer.write(_LEN.pack(5) + b"\xc1garb")  # invalid msgpack
+            await writer.drain()
+
+        srv = await asyncio.start_server(bad_server, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        client = RpcClient(("127.0.0.1", port), max_retries=0)
+        with pytest.raises(RpcConnectionLost):
+            await asyncio.wait_for(client.call("x"), timeout=5)
+        await client.close()
+        srv.close()
+        await srv.wait_closed()
+
+    asyncio.run(scenario())

@@ -507,3 +507,73 @@ def test_batch_llm_row_seed_content_derived():
     # numpy token dtypes hash identically to Python ints
     np = pytest.importorskip("numpy")
     assert seed(w, list(np.asarray([6, 7], np.int32))) == one_batch[1]
+
+
+# ---------------------------------------------------------------------------
+# pass 3c — graftrpc dispatch-plane schema drift
+# ---------------------------------------------------------------------------
+
+GRAFT_PY = os.path.join(REPO, "ray_tpu", "core", "_native", "graftrpc.py")
+GRAFT_CC = os.path.join(REPO, "csrc", "rpc_core.cc")
+
+
+def _mutated(tmp_path, src_path, old, new, name):
+    with open(src_path) as f:
+        text = f.read()
+    assert old in text, f"fixture drifted: {old!r} not in {src_path}"
+    p = tmp_path / name
+    p.write_text(text.replace(old, new, 1))
+    return str(p)
+
+
+def test_graft_schema_repo_in_sync():
+    fs = wire_schema.run_graft(GRAFT_PY, GRAFT_CC, "py", "cc")
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_graft_schema_detects_opcode_drift(tmp_path):
+    cc = _mutated(tmp_path, GRAFT_CC, "kOpIntern = 3", "kOpIntern = 7",
+                  "rpc_core.cc")
+    fs = wire_schema.run_graft(GRAFT_PY, cc, "py", "cc")
+    assert fs and all(f.rule == "wire-drift" for f in fs)
+    assert any("intern" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_graft_schema_detects_missing_opcode(tmp_path):
+    cc = _mutated(tmp_path, GRAFT_CC, "kOpGoaway = 5", "kOpGoaway2 = 5",
+                  "rpc_core.cc")
+    fs = wire_schema.run_graft(GRAFT_PY, cc, "py", "cc")
+    assert any("goaway" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_graft_schema_detects_header_width_drift(tmp_path):
+    cc = _mutated(tmp_path, GRAFT_CC, "uint16_t chan;", "uint32_t chan;",
+                  "rpc_core.cc")
+    fs = wire_schema.run_graft(GRAFT_PY, cc, "py", "cc")
+    assert fs and any("chan" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_graft_schema_detects_field_order_drift(tmp_path):
+    py = _mutated(tmp_path, GRAFT_PY, '("flags", 1),\n    ("chan", 2),',
+                  '("chan", 2),\n    ("flags", 1),', "graftrpc.py")
+    fs = wire_schema.run_graft(py, GRAFT_CC, "py", "cc")
+    assert fs and any("order" in f.message or "flags" in f.message
+                      for f in fs), [f.render() for f in fs]
+
+
+def test_graft_schema_detects_frame_cap_drift(tmp_path):
+    cc = _mutated(tmp_path, GRAFT_CC, "kMaxFrame = 64u << 20",
+                  "kMaxFrame = 32u << 20", "rpc_core.cc")
+    fs = wire_schema.run_graft(GRAFT_PY, cc, "py", "cc")
+    assert fs and any("cap" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_graft_schema_detects_struct_format_mismatch(tmp_path):
+    py = _mutated(tmp_path, GRAFT_PY, 'struct.Struct("<BBHQ")',
+                  'struct.Struct("<BBIQ")', "graftrpc.py")
+    fs = wire_schema.run_graft(py, GRAFT_CC, "py", "cc")
+    assert fs, "format/width mismatch not detected"

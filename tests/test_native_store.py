@@ -115,4 +115,6 @@ def test_native_store_sanitizers():
                              cwd=os.path.abspath(CSRC),
                              capture_output=True, text=True, timeout=600)
         assert out.returncode == 0, (target, out.stdout + out.stderr)
-        assert "ALL OK" in out.stdout, (target, out.stdout)
+        # Both native planes run sanitized: the store sidecar suite AND
+        # the graftrpc reactor suite each print their own ALL OK.
+        assert out.stdout.count("ALL OK") >= 2, (target, out.stdout)
