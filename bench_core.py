@@ -125,9 +125,38 @@ def bench_tasks_async():
     emit("single_client_tasks_async", rate, "tasks/s")
 
 
+def _put_phases():
+    """Core-worker put-phase counters (ns per phase + put count), or
+    None when the worker doesn't expose them."""
+    try:
+        from ray_tpu import api
+        return api._cw().put_phase_snapshot()
+    except Exception:
+        return None
+
+
+def emit_put_phases(tag: str, before, after) -> None:
+    """Per-put phase breakdown (serialize / copy / ingest-RPC, in us)
+    over the puts issued between the two snapshots — a put regression
+    in the headline metric localizes to one phase here."""
+    if before is None or after is None:
+        return
+    puts = after["puts"] - before["puts"]
+    if puts <= 0:
+        return
+    phases = {k: round((after[k] - before[k]) / puts / 1000, 1)
+              for k in ("serialize", "copy", "ingest")}
+    print(json.dumps({
+        "metric": f"put_phase_us_{tag}", "value": phases,
+        "unit": "us/put", "puts": puts, "host_cores": os.cpu_count(),
+    }), flush=True)
+
+
 def bench_put_calls():
     small = b"x" * 200_000  # >100KiB: forces the shm store path
+    before = _put_phases()
     rate = timed_loop(lambda: ray_tpu.put(small))
+    emit_put_phases("small", before, _put_phases())
     emit("single_client_put_calls", rate, "puts/s")
 
 
@@ -148,11 +177,13 @@ def bench_put_gigabytes():
         ray_tpu.put(arr)
 
     put_one()
+    before = _put_phases()
     t0 = time.perf_counter()
     reps = 2 if QUICK else 4
     for _ in range(reps):
         put_one()
     gbps = nbytes * reps / (time.perf_counter() - t0) / 1024 ** 3
+    emit_put_phases("gigabytes", before, _put_phases())
     emit("single_client_put_gigabytes", gbps, "GiB/s")
 
 

@@ -16,13 +16,16 @@
 // Thread-safe: a single mutex guards the index (operations are O(1)-ish and
 // the data path never holds it — clients write/read through their own mmaps).
 
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <list>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
+#include <vector>
 
 #include <fcntl.h>
 #include <sys/stat.h>
@@ -54,6 +57,16 @@ struct Store {
   std::mutex mu;
   std::unordered_map<std::string, ObjectEntry> objects;
   std::list<std::string> lru;  // front = oldest
+  // Deferred unlink: a GiB-scale tmpfs unlink frees pages synchronously
+  // (~50 ms/GiB) and EraseObject runs under mu on the put admission
+  // path, so eviction would stall every concurrent store op for that
+  // long. Victims are instead renamed (metadata-only) to a trash name
+  // and a background reaper unlinks them outside the lock.
+  std::vector<std::string> trash;
+  uint64_t trash_seq = 0;
+  std::condition_variable trash_cv;
+  std::thread reaper;
+  bool stopping = false;
 };
 
 std::string IdKey(const char* id) { return std::string(id, kIdSize); }
@@ -82,13 +95,55 @@ void LruRemove(Store* s, ObjectEntry* e) {
   }
 }
 
-// Caller holds mu. Removes entry + backing file.
-void EraseObject(Store* s, const std::string& key) {
+constexpr size_t kMaxTrashBacklog = 256;
+
+void ReaperLoop(Store* s) {
+  std::unique_lock<std::mutex> lk(s->mu);
+  while (!s->stopping) {
+    if (s->trash.empty()) {
+      s->trash_cv.wait(lk);
+      continue;
+    }
+    std::vector<std::string> batch;
+    batch.swap(s->trash);
+    lk.unlock();
+    for (const std::string& p : batch) ::unlink(p.c_str());
+    lk.lock();
+  }
+}
+
+// Caller holds mu. Removes entry + backing file. With out_unlink set,
+// the backing path is handed back for the caller to ::unlink AFTER
+// dropping mu: explicit deletes free their pages synchronously (the
+// worker blocks on the delete round-trip, so its next put reuses the
+// just-freed tmpfs pages — hot-page writes are ~2x faster than cold
+// allocation) without extending the critical section. With out_unlink
+// null (eviction, whose caller is the admission path and must not
+// block), the file is renamed to a trash name and reaped off-thread
+// (see Store::trash) unless the backlog is deep or the rename fails,
+// in which case it is unlinked inline.
+void EraseObject(Store* s, const std::string& key,
+                 std::string* out_unlink = nullptr) {
   auto it = s->objects.find(key);
   if (it == s->objects.end()) return;
   LruRemove(s, &it->second);
   s->used -= it->second.data_size + it->second.meta_size;
-  ::unlink(it->second.path.c_str());
+  const std::string& path = it->second.path;
+  if (out_unlink != nullptr) {
+    *out_unlink = path;
+    s->objects.erase(it);
+    return;
+  }
+  bool deferred = false;
+  if (s->reaper.joinable() && s->trash.size() < kMaxTrashBacklog) {
+    std::string tpath = path + ".t" + std::to_string(++s->trash_seq);
+    if (::rename(path.c_str(), tpath.c_str()) == 0) {
+      s->trash.push_back(std::move(tpath));
+      s->trash_cv.notify_one();
+      deferred = true;
+    }
+  }
+  if (!deferred) ::unlink(path.c_str());
   s->objects.erase(it);
 }
 
@@ -119,6 +174,7 @@ void* store_create(const char* dir, uint64_t capacity) {
   s->dir = dir;
   s->capacity = capacity;
   ::mkdir(dir, 0700);
+  s->reaper = std::thread(ReaperLoop, s);
   return s;
 }
 
@@ -126,6 +182,13 @@ void store_destroy(void* handle) {
   auto* s = static_cast<Store*>(handle);
   {
     std::lock_guard<std::mutex> g(s->mu);
+    s->stopping = true;
+  }
+  s->trash_cv.notify_all();
+  if (s->reaper.joinable()) s->reaper.join();
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    for (const std::string& p : s->trash) ::unlink(p.c_str());
     for (auto& kv : s->objects) ::unlink(kv.second.path.c_str());
   }
   ::rmdir(s->dir.c_str());
@@ -236,34 +299,47 @@ int store_get(void* handle, const char* id, char* out_path, int path_cap,
 // 0 ok, -1 missing.
 int store_release(void* handle, const char* id) {
   auto* s = static_cast<Store*>(handle);
-  std::lock_guard<std::mutex> g(s->mu);
-  std::string key = IdKey(id);
-  auto it = s->objects.find(key);
-  if (it == s->objects.end()) return -1;
-  ObjectEntry& e = it->second;
-  if (e.refcount > 0) e.refcount--;
-  if (e.refcount == 0) {
-    if (e.pending_delete) {
-      EraseObject(s, key);
-    } else if (e.sealed && !e.pinned && !e.in_lru) {
-      LruPush(s, key, &e);
+  std::string doomed;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    std::string key = IdKey(id);
+    auto it = s->objects.find(key);
+    if (it == s->objects.end()) return -1;
+    ObjectEntry& e = it->second;
+    if (e.refcount > 0) e.refcount--;
+    if (e.refcount == 0) {
+      if (e.pending_delete) {
+        EraseObject(s, key, &doomed);
+      } else if (e.sealed && !e.pinned && !e.in_lru) {
+        LruPush(s, key, &e);
+      }
     }
   }
+  if (!doomed.empty()) ::unlink(doomed.c_str());
   return 0;
 }
 
-// Deletes now if unreferenced, else marks pending-delete. 0 ok, -1 missing.
+// Deletes now if unreferenced, else marks pending-delete. The two
+// outcomes are distinct on purpose: 0 means the store's name is gone
+// NOW (a worker recycling its staging inode may rewrite the shared
+// pages), 1 means readers still hold it and the erase is deferred to
+// the last release. -1 missing.
 int store_delete(void* handle, const char* id) {
   auto* s = static_cast<Store*>(handle);
-  std::lock_guard<std::mutex> g(s->mu);
-  std::string key = IdKey(id);
-  auto it = s->objects.find(key);
-  if (it == s->objects.end()) return -1;
-  if (it->second.refcount == 0) {
-    EraseObject(s, key);
-  } else {
-    it->second.pending_delete = true;
+  std::string doomed;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    std::string key = IdKey(id);
+    auto it = s->objects.find(key);
+    if (it == s->objects.end()) return -1;
+    if (it->second.refcount == 0) {
+      EraseObject(s, key, &doomed);
+    } else {
+      it->second.pending_delete = true;
+    }
   }
+  if (doomed.empty()) return 1;
+  ::unlink(doomed.c_str());
   return 0;
 }
 

@@ -98,8 +98,9 @@ void TestCreateSealGetLifecycle() {
   assert(store_used(s) == 110);
   assert(store_num_objects(s) == 1);
 
-  // delete while referenced -> pending until release.
-  assert(store_delete(s, id.c_str()) == 0);
+  // delete while referenced -> pending until release (rc 1: the name
+  // survives, so staging-inode recyclers must not rewrite the pages).
+  assert(store_delete(s, id.c_str()) == 1);
   assert(store_contains(s, id.c_str()) == 1);  // still readable
   assert(store_release(s, id.c_str()) == 0);
   assert(store_contains(s, id.c_str()) == 0);

@@ -24,6 +24,17 @@
 // Ops: 1 INGEST(a=data_size, b=meta_size, name=ingest file)
 //      2 GET (pins; pair with RELEASE)   3 RELEASE
 //      4 DELETE                          5 CONTAINS (rc = 0/1/2)
+//      6 PUT (a=data_size, b=meta_size, name=put-* staging file): the
+//        fused graftcopy put — identical admission to INGEST (account,
+//        evict, rename-in, pin, journal as an ingest) but for the
+//        O_TMPFILE+linkat pipeline whose staging names derive from the
+//        object id ("put-<oid hex>"), so the worker needs no
+//        name-collision machinery at all. PUT and CONTAINS replies carry
+//        the connection's cumulative DROP counters (seen, erased) in
+//        their otherwise-unused ds/ms fields.
+//      7 DROP: fire-and-forget DELETE — processed and journaled like op 4
+//        but answered with NO reply frame; outcomes are reported via the
+//        counters on the next PUT/CONTAINS reply.
 
 #include <atomic>
 #include <cstdint>
@@ -56,7 +67,8 @@ namespace {
 
 constexpr int kIdSize = 20;
 constexpr uint8_t kOpIngest = 1, kOpGet = 2, kOpRelease = 3,
-                  kOpDelete = 4, kOpContains = 5;
+                  kOpDelete = 4, kOpContains = 5, kOpPut = 6,
+                  kOpDrop = 7;
 
 struct Event {       // journal entry: 29 bytes packed on drain
   uint8_t op;        // kOpIngest | kOpDelete
@@ -137,6 +149,11 @@ void* ConnLoop(void* argp) {
   // RELEASE must not leak pins (the reference plasma store releases a
   // disconnected client's pins the same way).
   std::unordered_map<std::string, int> pins;
+  // Cumulative fire-and-forget delete outcomes (kOpDrop). DROP writes no
+  // reply; these counters ride the otherwise-unused ds/ms fields of the
+  // next PUT reply so the client can settle its in-flight drop list with
+  // zero extra wakeups.
+  uint64_t drops_seen = 0, drops_erased = 0;
   for (;;) {
     uint8_t op;
     uint64_t a, b;
@@ -155,10 +172,14 @@ void* ConnLoop(void* argp) {
     uint16_t plen = 0;
     path[0] = 0;
     switch (op) {
-      case kOpIngest: {
-        // Same validation as the agent RPC: relative ingest-file names
-        // only — a worker must not rename arbitrary paths in.
-        if (std::strncmp(name, "ingest-", 7) != 0 ||
+      case kOpIngest:
+      case kOpPut: {
+        // Same validation as the agent RPC: relative staging-file names
+        // only — a worker must not rename arbitrary paths in. INGEST
+        // takes the legacy per-worker "ingest-" names; PUT takes the
+        // oid-derived "put-" names of the graftcopy pipeline.
+        const char* prefix = (op == kOpPut) ? "put-" : "ingest-";
+        if (std::strncmp(name, prefix, std::strlen(prefix)) != 0 ||
             std::strchr(name, '/') != nullptr) {
           rc = -4;
           break;
@@ -166,9 +187,25 @@ void* ConnLoop(void* argp) {
         std::string src = s->dir + "/" + name;
         rc = store_ingest_object(s->store, oid, src.c_str(), a, b,
                                  /*pinned=*/1);
+        // Journaled as an ingest either way: the agent's bookkeeping
+        // (primary ledger, seal waiters) is op-agnostic.
         if (rc == 0) Journal(s, kOpIngest, oid, a + b);
+        if (op == kOpPut) {
+          ds = drops_seen;
+          ms = drops_erased;
+        }
         break;
       }
+      case kOpDrop:
+        // Fire-and-forget delete: same semantics as DELETE but NO reply
+        // frame, so a worker's put/drop loop costs one context-switch
+        // cycle per iteration instead of two (a replied delete wakes
+        // the client mid-pipeline and preempts the sidecar). Outcomes
+        // accumulate into the per-connection counters above.
+        drops_seen++;
+        if (store_delete(s->store, oid) == 0) drops_erased++;
+        Journal(s, kOpDelete, oid, 0);
+        continue;
       case kOpGet:
         rc = store_get(s->store, oid, path, sizeof(path), &ds, &ms);
         if (rc == 0) {
@@ -190,6 +227,11 @@ void* ConnLoop(void* argp) {
         break;
       case kOpContains:
         rc = store_contains(s->store, oid);
+        // CONTAINS replies carry the drop counters too: the put plane
+        // confirms staging-inode reuse with a contains round-trip, and
+        // that same reply settles its in-flight drops.
+        ds = drops_seen;
+        ms = drops_erased;
         break;
       default:
         rc = -5;
@@ -353,12 +395,14 @@ int store_client_connect(const char* sock_path) {
   return fd;
 }
 
-// Returns 0 on transport success (rc/ds/ms/path filled), -1 on IO error
-// (caller should reconnect or fall back to the RPC path).
-int store_client_request(int fd, uint8_t op, const char* oid, uint64_t a,
-                         uint64_t b, const char* name, int32_t* rc_out,
-                         uint64_t* ds_out, uint64_t* ms_out,
-                         char* path_out, int path_cap) {
+// Send half of a request: frames and writes one op without waiting for
+// the reply. The server answers every request in order on the same
+// connection, so a caller may pipeline — send a fire-and-forget op
+// (delete), do useful work, and collect the reply with
+// store_client_recv before the next request. 0 ok, -1 IO error (the
+// connection is desynced; caller must reconnect).
+int store_client_send(int fd, uint8_t op, const char* oid, uint64_t a,
+                      uint64_t b, const char* name) {
   uint16_t nlen = name ? (uint16_t)std::strlen(name) : 0;
   char req[1 + kIdSize + 8 + 8 + 2];
   req[0] = (char)op;
@@ -368,6 +412,12 @@ int store_client_request(int fd, uint8_t op, const char* oid, uint64_t a,
   std::memcpy(req + 37, &nlen, 2);
   if (!WriteFull(fd, req, sizeof(req))) return -1;
   if (nlen && !WriteFull(fd, name, nlen)) return -1;
+  return 0;
+}
+
+// Receive half: blocks for exactly one reply. 0 ok, -1 IO error.
+int store_client_recv(int fd, int32_t* rc_out, uint64_t* ds_out,
+                      uint64_t* ms_out, char* path_out, int path_cap) {
   int32_t rc;
   uint64_t ds, ms;
   uint16_t plen;
@@ -382,6 +432,17 @@ int store_client_request(int fd, uint8_t op, const char* oid, uint64_t a,
   *ds_out = ds;
   *ms_out = ms;
   return 0;
+}
+
+// Returns 0 on transport success (rc/ds/ms/path filled), -1 on IO error
+// (caller should reconnect or fall back to the RPC path).
+int store_client_request(int fd, uint8_t op, const char* oid, uint64_t a,
+                         uint64_t b, const char* name, int32_t* rc_out,
+                         uint64_t* ds_out, uint64_t* ms_out,
+                         char* path_out, int path_cap) {
+  if (store_client_send(fd, op, oid, a, b, name) != 0) return -1;
+  return store_client_recv(fd, rc_out, ds_out, ms_out, path_out,
+                           path_cap);
 }
 
 void store_client_close(int fd) { ::close(fd); }

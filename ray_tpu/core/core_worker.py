@@ -236,6 +236,32 @@ class CoreWorker:
         self._fastpath_lock = threading.Lock()  # probe + ingest naming
         self._map_cache_lock = threading.Lock()
         self._ingest_seq = 0
+        # graftcopy put plane: fused OP_PUT with O_TMPFILE+linkat staging
+        # (csrc/copy_core.cc). None = unresolved; resolves to False when
+        # the flag is off or the native library is unavailable.
+        self._graftcopy_put: Optional[bool] = None
+        self._o_tmpfile_ok: Optional[bool] = None  # probed per process
+        # Staging-inode recycling: one private hardlink ("scratch-*")
+        # keeps the last staging file's tmpfs pages alive across the
+        # store's delete, so the next put rewrites hot pages instead of
+        # cold-allocating (cold allocation halves tmpfs write
+        # bandwidth). _scratch_oid is the live object sharing the
+        # inode; _scratch_freed collects oids whose store-side erase
+        # was confirmed (drop settled rc 0), flipping the scratch free
+        # again; _scratch_stale collects oids whose erase was deferred
+        # or lost, making the scratch leg abandon the inode.
+        self._scratch_lock = threading.Lock()
+        self._scratch_fd = -1
+        self._scratch_name: Optional[str] = None
+        self._scratch_size = 0
+        self._scratch_oid: Optional[bytes] = None
+        self._scratch_free = False
+        self._scratch_freed: set = set()
+        self._scratch_stale: set = set()
+        # Put-phase breakdown counters (ns + put count), read by
+        # bench_core.py so put regressions localize to a phase.
+        self._put_phase = {"serialize": 0, "copy": 0, "ingest": 0,
+                           "puts": 0}
         # Per-peer batched store frees (flushed on the next loop tick).
         self._free_buf: Dict[tuple, list] = {}
         self._free_flush_scheduled = False
@@ -565,9 +591,16 @@ class CoreWorker:
             owner = ref.owner_addr
             try:
                 if owner is None or tuple(owner) == self.address:
-                    # Owned drops are BATCHED: a burst of GC'd refs pays
-                    # one loop wakeup and zero Tasks for the common
-                    # no-contained-refs case (same shape as _spawn).
+                    # Common case first: a READY self-owned object with
+                    # one local store copy frees with one C sidecar call
+                    # RIGHT HERE — a loop wakeup (self-pipe write + loop
+                    # dispatch, ~70us on this VM class) costs more than
+                    # the free itself.
+                    if self._try_sync_drop(k):
+                        return
+                    # Everything else is BATCHED onto the loop: a burst
+                    # of GC'd refs pays one wakeup and zero Tasks for
+                    # the no-contained-refs case (same shape as _spawn).
                     self._owned_drop_buf.append(k)
                     if not self._owned_drop_scheduled:
                         self._owned_drop_scheduled = True
@@ -579,6 +612,61 @@ class CoreWorker:
                 self._owned_drop_scheduled = False  # loop shut down
         else:
             self._local_ref_counts[k] = n - 1
+
+    def _try_sync_drop(self, k: bytes) -> bool:
+        """Free a just-dropped SELF-OWNED object synchronously on the
+        calling thread when the cheap common case holds: entry READY
+        with no contained refs, no borrows, no device twin, and either
+        inline-only or exactly one LOCAL store copy reachable over the
+        sidecar. Anything unusual (pending, borrowed, remote copies, io
+        thread, no sidecar) returns False and takes the batched loop
+        path. Safe from user threads for the same reason fast-put is:
+        the ref count is already zero, so no new waiter can appear."""
+        if threading.get_ident() == getattr(self._io_thread, "ident",
+                                            None):
+            return False  # never block the loop on sidecar i/o
+        e = self.objects.get(k)
+        if e is None:
+            return True  # nothing tracked: the drop is complete
+        if (e.state != READY or e.contained or e.borrow_refs > 0
+                or k in self._device_objects or k in self._device_tokens):
+            return False
+        if not e.locations:
+            if e.inline is None:
+                return False  # odd state: let the loop path reason
+            self.objects.pop(k, None)
+            self._drop_map_cache(k)
+            return True
+        if len(e.locations) != 1 or self.agent_addr is None:
+            return False
+        (_nid, addr), = e.locations
+        if tuple(addr) != tuple(self.agent_addr):
+            return False
+        fp = self._fastpath if self._fastpath_probed else None
+        if fp is None:
+            return False
+        self.objects.pop(k, None)
+        self._drop_map_cache(k)
+        try:
+            # Fire-and-forget: the sidecar erases without replying; the
+            # outcome (rc 0 = name gone now) rides the next put/contains
+            # reply and feeds the staging-inode recycler.
+            fp.drop_async(k, self._scratch_note_delete)
+        except OSError:
+            # Connection lost mid-free: hand the store free to the
+            # batched RPC path (entry already dropped).
+            try:
+                self._loop.call_soon_threadsafe(self._queue_free, addr, k)
+            except RuntimeError:
+                pass
+        return True
+
+    def _queue_free(self, addr, oid: bytes) -> None:
+        """Loop-side: enqueue a store free for the batched flusher."""
+        self._free_buf.setdefault(tuple(addr), []).append(oid)
+        if not self._free_flush_scheduled:
+            self._free_flush_scheduled = True
+            self._loop.call_soon(self._flush_frees)
 
     def _drain_owned_drops(self) -> None:
         self._owned_drop_scheduled = False
@@ -1119,8 +1207,10 @@ class CoreWorker:
     # put / get / wait
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
+        t0 = time.perf_counter_ns()
         oid = ObjectID.from_put()
         sv = serialization.serialize(value)
+        self._put_phase["serialize"] += time.perf_counter_ns() - t0
         ref = ObjectRef(oid, self.address)
         self.add_local_ref(ref)
         # Fast path: a FRESH oid with no contained refs needs no loop
@@ -1128,9 +1218,30 @@ class CoreWorker:
         # argument as put_inline_marker), so serialize + write + one C
         # sidecar round-trip happens synchronously on this thread.
         if not sv.contained_refs and self._try_fast_put(oid.binary(), sv):
+            self._put_phase["puts"] += 1
             return ref
         self._run(self._do_put(oid.binary(), sv)).result()
+        self._put_phase["puts"] += 1
         return ref
+
+    def put_phase_snapshot(self) -> Dict[str, int]:
+        """Copy of the put-phase breakdown counters (ns per phase +
+        total puts); consumed by bench_core.py so a put regression
+        localizes to serialize vs copy vs ingest-RPC."""
+        return dict(self._put_phase)
+
+    def _use_graftcopy(self) -> bool:
+        """Resolve (once per process) whether the fused graftcopy put
+        plane is on: flag set AND the native library loads."""
+        g = self._graftcopy_put
+        if g is None:
+            try:
+                from ray_tpu.core._native import graftcopy
+                g = graftcopy.available()
+            except Exception:
+                g = False
+            self._graftcopy_put = g
+        return g
 
     def _try_fast_put(self, oid: bytes, sv) -> bool:
         meta = sv.meta()
@@ -1139,9 +1250,17 @@ class CoreWorker:
             self.put_inline_marker(oid, sv)
             return True
         fp = self._get_fastpath()
-        # Big payloads keep the executor-offloaded loop path (the write
-        # would block this thread for tens of ms anyway).
-        if fp is None or total > 4 * 1024 * 1024:
+        if fp is None:
+            return False
+        if self._use_graftcopy():
+            # graftcopy plane: ALL sizes stay synchronous on the user
+            # thread (it blocks on the put anyway, and both pwritev and
+            # the ctypes scatter call drop the GIL for the copy), so a
+            # GiB put pays zero loop hops: stage + one fused OP_PUT.
+            return self._put_direct(oid, sv, meta, fp)
+        # Legacy plane: big payloads keep the executor-offloaded loop
+        # path (same knob that gates the loop path's executor hop).
+        if total > GlobalConfig.put_executor_offload_bytes:
             return False
         sdir = self._store_dir_cache
         name = self._next_ingest_name()
@@ -1149,8 +1268,7 @@ class CoreWorker:
         try:
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
             try:
-                sv.write_to_fd(fd)
-                os.pwrite(fd, meta, sv.total_size)
+                sv.write_to_fd(fd, meta)
             finally:
                 os.close(fd)
             rc = fp.ingest(oid, name, sv.total_size, len(meta))
@@ -1173,6 +1291,239 @@ class CoreWorker:
         self._mark_ready_stored(oid, self.node_id, self.agent_addr,
                                 sv.total_size)
         return True
+
+    def _put_direct(self, oid: bytes, sv, meta: bytes, fp) -> bool:
+        """Fused put: stage the payload (O_TMPFILE+linkat where the fs
+        supports it, else a named O_EXCL file), then ONE sidecar OP_PUT
+        round-trip that accounts + renames in + pins + journals. The
+        staging name derives from the oid — unique by construction, so
+        none of the ingest-name collision machinery applies here. Any
+        failure returns False and the loop path (whose create+seal leg
+        can evict/spill before bytes land) takes over."""
+        phase = self._put_phase
+        sdir = self._store_dir_cache
+        t0 = time.perf_counter_ns()
+        try:
+            name = self._write_put_file(sdir, oid, sv, meta)
+        except FileExistsError:
+            # oid-derived name taken: THIS object is already being (or
+            # has been) put — let the loop path resolve it idempotently.
+            return False
+        except OSError:
+            # ENOSPC before the store could account/evict, or linkat
+            # unsupported mid-flight: fall back (create+seal admission
+            # evicts/spills BEFORE any bytes land).
+            return False
+        t1 = time.perf_counter_ns()
+        phase["copy"] += t1 - t0
+        path = os.path.join(sdir, name)
+        try:
+            rc = fp.put(oid, name, sv.total_size, len(meta))
+        except OSError:
+            # Sidecar died mid-put: orphaned staging file is swept by
+            # the agent; the loop path reconnects or RPCs.
+            self._drop_staged(path, oid)
+            return False
+        phase["ingest"] += time.perf_counter_ns() - t1
+        if rc == -1:
+            # Already stored: puts are idempotent — success, drop ours.
+            self._drop_staged(path, oid)
+        elif rc != 0:
+            # Full (-2) or rename failure: the RPC path can spill.
+            self._drop_staged(path, oid)
+            return False
+        e = self._entry(oid, create=True)
+        e.creating_task = None
+        e.contained = []
+        self._mark_ready_stored(oid, self.node_id, self.agent_addr,
+                                sv.total_size)
+        return True
+
+    def _drop_staged(self, path: str, oid: bytes) -> None:
+        """Remove a staged put- name the store did not adopt. When the
+        unlink itself succeeds the rename provably never happened, so a
+        scratch inode staged for this oid is sole-owned again and may
+        be recycled; when it fails (ENOENT — the sidecar may have
+        renamed before the connection died) the scratch stays
+        conservatively busy until abandoned."""
+        try:
+            os.unlink(path)
+        except OSError:
+            return
+        self._scratch_note_delete(oid, 0)
+
+    def _scratch_note_delete(self, oid: bytes, rc: int) -> None:
+        """Record the settled fate of the object sharing the scratch
+        inode: rc 0 (name erased now) feeds the freed-set; anything
+        else (deferred behind live readers, connection lost) feeds the
+        stale-set, which makes the scratch leg abandon the inode rather
+        than guess. Runs under the fastpath client lock from drop
+        settlement, so it only touches the sets; the scratch leg folds
+        them in under the scratch lock."""
+        if oid != self._scratch_oid:
+            return
+        if rc == 0:
+            self._scratch_freed.add(oid)
+        else:
+            self._scratch_stale.add(oid)
+
+    def _scratch_try_write(self, sdir: str, path: str, oid: bytes,
+                           total: int, sv, meta: bytes, fp) -> bool:
+        """Stage via the recycled scratch inode when it is provably
+        unshared. Returns False (caller takes the fresh-inode leg) when
+        recycling is off, the payload exceeds the cap, another thread
+        holds the scratch, or the tenant's erase is still unconfirmed;
+        raises like _write_put_file on write or link failure.
+
+        Confirmation policy: the tenant's fire-and-forget drop settles
+        on the NEXT counter-carrying sidecar reply, so small payloads
+        whose tenant is still unsettled just take the fresh-inode leg
+        this round (the scratch stays parked; by the next put the
+        previous put's own reply has settled it) — at 200KiB the cold
+        pages cost less than any extra round-trip. Large payloads
+        (>= graftcopy_min_bytes) spend one CONTAINS round-trip: the
+        server answers requests in order on the shared connection, so
+        the queued drop has provably been processed by reply time and
+        ABSENT means the inode is unshared — ~85us buying back a 2x
+        bandwidth difference on the GiB-scale write."""
+        cap = GlobalConfig.graftcopy_scratch_max_bytes
+        if cap <= 0 or total > cap:
+            return False
+        if not self._scratch_lock.acquire(blocking=False):
+            return False
+        try:
+            if self._scratch_fd >= 0 and not self._scratch_free:
+                tenant = self._scratch_oid
+                if (tenant not in self._scratch_freed
+                        and tenant not in self._scratch_stale
+                        and fp is not None
+                        and total >= GlobalConfig.graftcopy_min_bytes):
+                    try:
+                        if fp.contains(tenant) == 0:
+                            self._scratch_freed.add(tenant)
+                        else:
+                            self._scratch_stale.add(tenant)
+                    except OSError:
+                        pass  # conn lost: fate unknown this round
+                if tenant in self._scratch_freed:
+                    self._scratch_freed.discard(tenant)
+                    self._scratch_oid = None
+                    self._scratch_free = True
+                elif tenant in self._scratch_stale:
+                    # Tenant provably alive (delete deferred behind
+                    # readers) or its fate unknowable: drop OUR link —
+                    # the store's copy is untouched — and start over.
+                    self._scratch_stale.discard(tenant)
+                    self._scratch_close()
+                else:
+                    return False  # drop unsettled: park the scratch
+            if self._scratch_fd < 0:
+                sname = (f"scratch-{self.worker_id.hex()[:16]}-"
+                         f"{os.getpid()}")
+                spath = os.path.join(sdir, sname)
+                try:
+                    self._scratch_fd = os.open(
+                        spath, os.O_CREAT | os.O_RDWR, 0o600)
+                except OSError:
+                    return False
+                self._scratch_name = sname
+                self._scratch_size = 0
+                self._scratch_oid = None
+                self._scratch_free = True
+            fd = self._scratch_fd
+            spath = os.path.join(sdir, self._scratch_name)
+            if self._scratch_size != total:
+                os.ftruncate(fd, total)
+                self._scratch_size = total
+            serialization.write_payload(fd, sv, meta)
+            try:
+                # Publish: the put- name and the scratch share the
+                # inode until the store's delete drops its side.
+                os.link(spath, path)
+            except FileNotFoundError:
+                # The agent swept our idle scratch name: the cached fd
+                # points at a dead inode. Recover on the fresh leg.
+                self._scratch_close(unlink=False)
+                return False
+            self._scratch_freed.discard(oid)
+            self._scratch_oid = oid
+            self._scratch_free = False
+            return True
+        finally:
+            self._scratch_lock.release()
+
+    def _scratch_close(self, unlink: bool = True) -> None:
+        """Drop the scratch fd and (optionally) its name; pages of a
+        live tenant survive via the store's own hex link."""
+        if self._scratch_fd >= 0:
+            try:
+                os.close(self._scratch_fd)
+            except OSError:
+                pass
+            self._scratch_fd = -1
+        if unlink and self._scratch_name and self._store_dir_cache:
+            try:
+                os.unlink(os.path.join(self._store_dir_cache,
+                                       self._scratch_name))
+            except OSError:
+                pass
+        self._scratch_name = None
+        self._scratch_oid = None
+        self._scratch_free = False
+        self._scratch_freed.clear()
+        self._scratch_stale.clear()
+
+    def _open_put_file(self, sdir: str, path: str) -> Tuple[int, bool]:
+        """-> (fd, named). Prefers an anonymous O_TMPFILE in the store
+        dir (a crash mid-write leaves NOTHING to sweep; linkat publishes
+        it atomically once the bytes are down); the named-O_EXCL
+        fallback covers filesystems without O_TMPFILE. The probe result
+        is cached per process."""
+        if self._o_tmpfile_ok is not False:
+            tmp = getattr(os, "O_TMPFILE", 0)
+            if tmp:
+                try:
+                    fd = os.open(sdir, tmp | os.O_RDWR, 0o600)
+                    self._o_tmpfile_ok = True
+                    return fd, False
+                except OSError:
+                    self._o_tmpfile_ok = False
+            else:
+                self._o_tmpfile_ok = False
+        return os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600), True
+
+    def _write_put_file(self, sdir: str, oid: bytes, sv, meta: bytes) -> str:
+        """Stage a put payload under its oid-derived name and return the
+        name. Shared by the sync fast path and the loop path, so both
+        use the same O_TMPFILE+linkat staging and the same
+        serialization.write_payload seam (pwritev or the native scatter
+        engine). Raises FileExistsError when the name is taken (the
+        object is already being put) and OSError on write failure; in
+        both cases nothing is left published at the name."""
+        name = "put-" + oid.hex()
+        path = os.path.join(sdir, name)
+        fp = self._fastpath if self._fastpath_probed else None
+        if self._scratch_try_write(sdir, path, oid,
+                                   sv.total_size + len(meta), sv, meta,
+                                   fp):
+            return name
+        fd, named = self._open_put_file(sdir, path)
+        try:
+            try:
+                serialization.write_payload(fd, sv, meta)
+                if not named:
+                    from ray_tpu.core._native import graftcopy
+                    graftcopy.linkat(fd, path)
+            except BaseException:
+                if named:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                raise
+        finally:
+            os.close(fd)
+        return name
 
     def _next_ingest_name(self) -> str:
         """Ingest-file name unique ACROSS pid namespaces: containerized
@@ -1270,57 +1621,85 @@ class CoreWorker:
             else:
                 sdir = ""
 
+        offload = GlobalConfig.put_executor_offload_bytes
+
         def _write_at(path, flags):
-            # pwrite, not mmap+populate: kernel-side bulk copies run ~2x
-            # faster than the per-page fault+PTE path on this VM class
-            # (3.1 vs 1.6 GiB/s raw for a 1 GiB tmpfs write).
+            # pwrite-family, not mmap+populate: kernel-side bulk copies
+            # run ~2x faster than the per-page fault+PTE path on this VM
+            # class (3.1 vs 1.6 GiB/s raw for a 1 GiB tmpfs write).
+            # write_payload routes GiB-scale copies through the native
+            # scatter engine when available.
             fd = os.open(path, flags, 0o600)
             try:
-                sv.write_to_fd(fd)
-                os.pwrite(fd, meta, sv.total_size)
+                serialization.write_payload(fd, sv, meta)
             finally:
                 os.close(fd)
 
         loop = asyncio.get_running_loop()
         if sdir:
-            name = self._next_ingest_name()
-            path = os.path.join(sdir, name)
-            flags = os.O_CREAT | os.O_RDWR | os.O_EXCL
-            wrote = False
-            try:
-                # Big copies run OFF the io loop (a 1 GiB put must not
-                # stall RPC).
-                if total > 4 * 1024 * 1024:
-                    await loop.run_in_executor(None, _write_at, path,
-                                               flags)
-                else:
-                    # lint: allow-blocking(<=4MiB tmpfs write; executor hop costs more than the copy)
-                    _write_at(path, flags)
-                wrote = True
-            except FileExistsError:
-                # O_EXCL lost a NAME collision: that file is another
-                # writer's in-flight payload — never unlink it, never
-                # claim success (r5 advisor: the old treat-as-success
-                # here silently lost objects). Names embed worker_id so
-                # this is near-impossible; fall through to create+seal.
-                logger.warning("ingest name collision on %s; using the "
-                               "create+seal path", name)
-            except OSError:
-                # Write failed (e.g. tmpfs ENOSPC before the store could
-                # account/evict): clean up and fall through to the
-                # create-first path, whose admission evicts/spills BEFORE
-                # any bytes land.
+            name = None
+            if self._use_graftcopy():
+                # Unified staging: same O_TMPFILE+linkat + write_payload
+                # helper as the sync fast path, with the oid-derived
+                # name (no collision machinery). Only the ingest RPC
+                # differs — this coroutine runs on the io loop, where
+                # the blocking sidecar socket is off-limits.
                 try:
-                    os.unlink(path)
+                    if total > offload:
+                        # Big copies run OFF the io loop (a 1 GiB put
+                        # must not stall RPC).
+                        name = await loop.run_in_executor(
+                            None, self._write_put_file, sdir, oid, sv,
+                            meta)
+                    else:
+                        # lint: allow-blocking(small tmpfs write; executor hop costs more than the copy)
+                        name = self._write_put_file(sdir, oid, sv, meta)
+                except FileExistsError:
+                    # oid-derived name taken: this object is already
+                    # being put; create+seal resolves idempotently.
+                    logger.warning("put staging name for %s already "
+                                   "exists; using the create+seal path",
+                                   oid.hex())
                 except OSError:
-                    pass
-            except BaseException:
+                    pass  # e.g. ENOSPC: create+seal admission spills
+            else:
+                legacy = self._next_ingest_name()
+                path = os.path.join(sdir, legacy)
+                flags = os.O_CREAT | os.O_RDWR | os.O_EXCL
                 try:
-                    os.unlink(path)
+                    if total > offload:
+                        await loop.run_in_executor(None, _write_at, path,
+                                                   flags)
+                    else:
+                        # lint: allow-blocking(small tmpfs write; executor hop costs more than the copy)
+                        _write_at(path, flags)
+                    name = legacy
+                except FileExistsError:
+                    # O_EXCL lost a NAME collision: that file is another
+                    # writer's in-flight payload — never unlink it,
+                    # never claim success (r5 advisor: the old
+                    # treat-as-success here silently lost objects).
+                    # Names embed worker_id so this is near-impossible;
+                    # fall through to create+seal.
+                    logger.warning("ingest name collision on %s; using "
+                                   "the create+seal path", legacy)
                 except OSError:
-                    pass
-                raise
-            if wrote:
+                    # Write failed (e.g. tmpfs ENOSPC before the store
+                    # could account/evict): clean up and fall through to
+                    # the create-first path, whose admission
+                    # evicts/spills BEFORE any bytes land.
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                except BaseException:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    raise
+            if name is not None:
+                path = os.path.join(sdir, name)
                 try:
                     await self.agent.call("store_ingest", oid, name,
                                           sv.total_size, len(meta))
@@ -1346,7 +1725,7 @@ class CoreWorker:
                     raise
         path = await self.agent.call("store_create", oid, sv.total_size,
                                      len(meta))
-        if total > 4 * 1024 * 1024:
+        if total > offload:
             await loop.run_in_executor(None, _write_at, path, os.O_RDWR)
         else:
             _write_at(path, os.O_RDWR)
@@ -3306,6 +3685,10 @@ class CoreWorker:
             self._exec_pool.shutdown(wait=False)
         except Exception:
             pass
+
+        # Drop the recycled staging inode (its pages die with us; live
+        # objects hold their own hex link).
+        self._scratch_close()
 
         async def _close_graft():
             # Loop-affine close (sends happen only on this loop, so the

@@ -371,11 +371,23 @@ class NodeAgent:
                 last_sweep = now
                 try:
                     for name in os.listdir(self.store.dir):
-                        if not name.startswith("ingest-"):
+                        # "put-" files are graftcopy stagings (worker
+                        # died between linkat and OP_PUT/store_ingest).
+                        # "scratch-" files are per-worker recycled
+                        # staging inodes: long-idle ones belong to dead
+                        # (or dormant) workers and pin tmpfs pages;
+                        # dropping the name is always safe — a live
+                        # object's hex link is untouched, and a live
+                        # worker recovers with a fresh scratch.
+                        if name.startswith("scratch-"):
+                            age_cap = 600
+                        elif name.startswith(("ingest-", "put-")):
+                            age_cap = 120
+                        else:
                             continue
                         p = os.path.join(self.store.dir, name)
                         try:
-                            if time.time() - os.path.getmtime(p) > 120:
+                            if time.time() - os.path.getmtime(p) > age_cap:
                                 os.unlink(p)
                         except OSError:
                             pass
@@ -1274,7 +1286,7 @@ class NodeAgent:
         primary. Collapses the create+seal round-trips (the accounting
         window moves to ingest time — tmpfs briefly holds the payload
         unaccounted, bounded by the writer's in-flight puts)."""
-        if not src_name.startswith("ingest-") or "/" in src_name:
+        if not src_name.startswith(("ingest-", "put-")) or "/" in src_name:
             raise ValueError(f"bad ingest source {src_name!r}")
         src = os.path.join(self.store.dir, src_name)
         o = ObjectID(oid)

@@ -101,7 +101,27 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_uint64, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_char_p, ctypes.c_int]
+    lib.store_client_send.restype = ctypes.c_int
+    lib.store_client_send.argtypes = [
+        ctypes.c_int, ctypes.c_uint8, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_char_p]
+    lib.store_client_recv.restype = ctypes.c_int
+    lib.store_client_recv.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_char_p, ctypes.c_int]
     lib.store_client_close.argtypes = [ctypes.c_int]
+    # graftcopy engine (copy_core.cc).
+    lib.copy_engine_create.restype = ctypes.c_void_p
+    lib.copy_engine_create.argtypes = [ctypes.c_int]
+    lib.copy_engine_destroy.argtypes = [ctypes.c_void_p]
+    lib.copy_engine_threads.restype = ctypes.c_int
+    lib.copy_engine_threads.argtypes = [ctypes.c_void_p]
+    lib.copy_write_scatter.restype = ctypes.c_int
+    lib.copy_write_scatter.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_int]
+    lib.copy_linkat.restype = ctypes.c_int
+    lib.copy_linkat.argtypes = [ctypes.c_int, ctypes.c_char_p]
     return lib
 
 
@@ -271,6 +291,8 @@ class FastStoreClient:
     client socket, reference: plasma/client.cc)."""
 
     OP_INGEST, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS = 1, 2, 3, 4, 5
+    OP_PUT = 6
+    OP_DROP = 7
 
     def __init__(self, sock_path: str):
         import threading
@@ -284,25 +306,73 @@ class FastStoreClient:
         self._ds = ctypes.c_uint64()
         self._ms = ctypes.c_uint64()
         self._path = ctypes.create_string_buffer(4096)
+        # Fire-and-forget deletes (OP_DROP) not yet settled by a
+        # counter-carrying reply: [(oid, callback)] in send order.
+        self._drops: list = []
+        self._drops_acked = 0   # cumulative server counters already
+        self._erased_acked = 0  # applied (per connection)
+
+    def _fail_locked(self) -> None:
+        # NEVER reuse a desynced connection: a partial write/read would
+        # make the next op parse this op's stale reply. In-flight drops
+        # settle conservatively (rc 1: outcome unknown).
+        self._lib.store_client_close(self._fd)
+        self._fd = -1
+        self._expire_drops_locked()
+        raise OSError("store fast path connection lost")
+
+    def _reconnect_locked(self) -> None:
+        self._fd = self._lib.store_client_connect(self._sock_path.encode())
+        if self._fd < 0:
+            raise OSError("store fast path unreachable")
+        # Drop counters are per-connection on the server: start clean.
+        self._expire_drops_locked()
+
+    def _expire_drops_locked(self) -> None:
+        drops, self._drops = self._drops, []
+        self._drops_acked = 0
+        self._erased_acked = 0
+        for oid, cb in drops:
+            if cb is not None:
+                cb(oid, 1)
+
+    def _settle_drops_locked(self, seen: int, erased: int) -> None:
+        """Apply the cumulative drop counters a PUT/CONTAINS reply
+        carried: the oldest (seen - acked) in-flight drops are settled.
+        Counters are monotonic per connection, so a reply applied out of
+        order (two threads racing past _req) is a harmless no-op."""
+        n = seen - self._drops_acked
+        if n <= 0:
+            return
+        batch = self._drops[:n]
+        del self._drops[:n]
+        # rc 0 only when EVERY drop in the batch erased immediately —
+        # batch-wide because the counters don't say which ones. The put
+        # plane sends one drop per put, so batches are length 1 there.
+        all_erased = (erased - self._erased_acked) == n
+        self._drops_acked = seen
+        self._erased_acked = erased
+        for oid, cb in batch:
+            if cb is not None:
+                cb(oid, 0 if all_erased else 1)
+
+    def _settle_drops(self, seen: int, erased: int) -> None:
+        if seen == 0 and not self._drops:
+            return
+        with self._lock:
+            self._settle_drops_locked(seen, erased)
 
     def _req(self, op: int, oid: bytes, a: int = 0, b: int = 0,
              name: Optional[bytes] = None) -> Tuple[int, int, int, str]:
         with self._lock:
             if self._fd < 0:  # previous transport error: reconnect once
-                self._fd = self._lib.store_client_connect(
-                    self._sock_path.encode())
-                if self._fd < 0:
-                    raise OSError("store fast path unreachable")
+                self._reconnect_locked()
             ok = self._lib.store_client_request(
                 self._fd, op, oid, a, b, name, ctypes.byref(self._rc),
                 ctypes.byref(self._ds), ctypes.byref(self._ms),
                 self._path, 4096)
             if ok != 0:
-                # NEVER reuse a desynced connection: a partial write/read
-                # would make the next op parse this op's stale reply.
-                self._lib.store_client_close(self._fd)
-                self._fd = -1
-                raise OSError("store fast path connection lost")
+                self._fail_locked()
             return (self._rc.value, self._ds.value, self._ms.value,
                     self._path.value.decode())
 
@@ -310,6 +380,17 @@ class FastStoreClient:
                meta_size: int) -> int:
         rc, _, _, _ = self._req(self.OP_INGEST, oid, data_size, meta_size,
                                 name.encode())
+        return rc
+
+    def put(self, oid: bytes, name: str, data_size: int,
+            meta_size: int) -> int:
+        """Fused graftcopy put: adopt the 'put-<oid hex>' staging file as
+        a sealed pinned object in one round-trip (OP_PUT; same admission
+        as ingest, oid-derived staging names). The reply's ds/ms carry
+        the connection's cumulative drop counters; settle them here."""
+        rc, ds, ms, _ = self._req(self.OP_PUT, oid, data_size, meta_size,
+                                  name.encode())
+        self._settle_drops(ds, ms)
         return rc
 
     def get(self, oid: bytes) -> Optional[Tuple[str, int, int]]:
@@ -322,10 +403,45 @@ class FastStoreClient:
         self._req(self.OP_RELEASE, oid)
 
     def delete(self, oid: bytes) -> int:
+        """0 erased now, 1 deferred behind live readers, -1 missing."""
         return self._req(self.OP_DELETE, oid)[0]
 
+    def drop_async(self, oid: bytes, cb=None) -> None:
+        """Fire-and-forget delete (OP_DROP): the sidecar processes and
+        journals it like OP_DELETE but writes NO reply, so a put/drop
+        loop costs one context-switch cycle per iteration — a replied
+        delete wakes this process mid-pipeline and preempts the sidecar
+        before it reaches the put. `cb(oid, rc)` fires when a later
+        PUT/CONTAINS reply settles the drop (under the client lock:
+        keep it trivial, never call back into this client). rc 0 means
+        erased immediately (the staging inode's pages are reclaimable);
+        rc 1 means deferred or unknown (connection loss settles all
+        in-flight drops as 1)."""
+        with self._lock:
+            if self._fd < 0:
+                self._reconnect_locked()
+            if len(self._drops) >= 64:
+                # Runaway guard (a caller that drops but never puts):
+                # one replied CONTAINS settles the backlog. The put
+                # plane interleaves drops and puts 1:1, so this is the
+                # pathological path only.
+                ok = self._lib.store_client_request(
+                    self._fd, self.OP_CONTAINS, oid, 0, 0, None,
+                    ctypes.byref(self._rc), ctypes.byref(self._ds),
+                    ctypes.byref(self._ms), self._path, 4096)
+                if ok != 0:
+                    self._fail_locked()
+                self._settle_drops_locked(self._ds.value, self._ms.value)
+            ok = self._lib.store_client_send(
+                self._fd, self.OP_DROP, oid, 0, 0, None)
+            if ok != 0:
+                self._fail_locked()
+            self._drops.append((oid, cb))
+
     def contains(self, oid: bytes) -> int:
-        return self._req(self.OP_CONTAINS, oid)[0]
+        rc, ds, ms, _ = self._req(self.OP_CONTAINS, oid)
+        self._settle_drops(ds, ms)
+        return rc
 
     def close(self) -> None:
         if self._fd >= 0:

@@ -27,9 +27,37 @@ ALIGN = 64
 
 _local = threading.local()
 
+_PAD = b"\0" * ALIGN
+
+# writev/pwritev iovec cap (UIO_MAXIOV); batches larger than this loop.
+try:
+    _IOV_MAX = os.sysconf("SC_IOV_MAX")
+    if _IOV_MAX <= 0:
+        _IOV_MAX = 1024
+except (ValueError, OSError, AttributeError):
+    _IOV_MAX = 1024
+
 
 def _align(n: int) -> int:
     return (n + ALIGN - 1) // ALIGN * ALIGN
+
+
+def _pwritev_full(fd: int, bufs, offset: int) -> None:
+    """pwritev the buffer list contiguously at `offset`, handling
+    IOV_MAX batching and partial writes (a single pwritev tops out at
+    ~2 GiB on Linux)."""
+    queue = list(bufs)
+    while queue:
+        window = queue[:_IOV_MAX]
+        n = os.pwritev(fd, window, offset)
+        offset += n
+        consumed = 0
+        while consumed < len(window) and n >= len(window[consumed]):
+            n -= len(window[consumed])
+            consumed += 1
+        del queue[:consumed]
+        if n and queue:
+            queue[0] = memoryview(queue[0])[n:]
 
 
 class SerializedValue:
@@ -78,29 +106,75 @@ class SerializedValue:
         self.write_into(memoryview(out))
         return bytes(out)
 
-    def write_to_fd(self, fd: int) -> None:
-        """pwrite the data section into a FRESH (zero-filled) file.
-
-        2x faster than the mmap+MAP_POPULATE path on tmpfs for GiB-scale
-        buffers (3.1 vs 1.6 GiB/s measured on this VM class: pwrite does
-        kernel-side bulk copies instead of per-page fault+PTE dances).
-        Alignment gaps are never written — a fresh tmpfs file reads back
-        zeros there.
-        """
+    def segments(self, meta: bytes = b"") -> list:
+        """[(buffer, file_offset)] covering the data section (and meta,
+        when given, at the aligned tail). Alignment gaps are skipped —
+        in a fresh file they are holes that read back zeros."""
+        out = []
         pb = self.pickle_bytes
-        os.pwrite(fd, pb, 0)
+        if pb:
+            out.append((pb, 0))
         off = _align(len(pb))
         for b in self.buffers:
             raw = b.raw()
-            n = len(raw)
-            pos = 0
-            # Chunked: each pwrite drops the GIL, so the io loop stays
-            # responsive during a GiB-scale copy.
-            while pos < n:
-                end = min(n, pos + self._COPY_CHUNK)
-                os.pwrite(fd, raw[pos:end], off + pos)
-                pos = end
-            off = _align(off + n)
+            if len(raw):
+                out.append((raw, off))
+            off = _align(off + len(raw))
+        if meta:
+            out.append((meta, off))
+        return out
+
+    def write_to_fd(self, fd: int, meta: bytes = b"") -> None:
+        """Vectored write of the data section (and optionally meta at
+        the aligned tail) into a FRESH (zero-filled) file: ONE os.pwritev
+        instead of a pwrite per chunk per buffer (pwritev drops the GIL
+        for its whole duration, so chunking bought responsiveness
+        nothing and cost a syscall per 32 MiB). pwrite-family beats the
+        mmap+MAP_POPULATE path 2x on tmpfs for GiB-scale buffers (3.1 vs
+        1.6 GiB/s on this VM class: kernel-side bulk copies instead of
+        per-page fault+PTE dances). Alignment gaps are filled from a
+        shared zero pad so the write is contiguous."""
+        iov = []
+        off = 0
+        pb = self.pickle_bytes
+        if pb:
+            iov.append(pb)
+            off = len(pb)
+        for b in self.buffers:
+            raw = b.raw()
+            aligned = _align(off)
+            if aligned != off:
+                iov.append(_PAD[:aligned - off])
+                off = aligned
+            if len(raw):
+                iov.append(raw)
+                off += len(raw)
+        if meta:
+            aligned = _align(off)
+            if aligned != off:
+                iov.append(_PAD[:aligned - off])
+                off = aligned
+            iov.append(meta)
+        if iov:
+            _pwritev_full(fd, iov, 0)
+
+
+def write_payload(fd: int, sv: SerializedValue, meta: bytes = b"") -> None:
+    """Land sv's data section (+ meta at the aligned tail) into a fresh
+    fd via the fastest available path: the graftcopy scatter engine for
+    large payloads on multi-core hosts (GIL-free worker-pool copy,
+    csrc/copy_core.cc), else one vectored pwritev. The single put-plane
+    write seam — both the sync fast path and the loop path call this."""
+    from ray_tpu.utils.config import GlobalConfig
+    if sv.total_size + len(meta) >= GlobalConfig.graftcopy_min_bytes:
+        from ray_tpu.core._native import graftcopy
+        if graftcopy.available() and graftcopy.engine_threads() > 0:
+            try:
+                graftcopy.write_scatter(fd, sv.segments(meta))
+                return
+            except ValueError:
+                pass  # read-only segment the engine can't borrow
+    sv.write_to_fd(fd, meta)
 
 
 def serialize(value: Any) -> SerializedValue:

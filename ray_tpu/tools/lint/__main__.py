@@ -115,6 +115,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings.append(Finding(
                 "<wire>", 1, wire_schema.RULE, "error",
                 f"graftrpc schema sources missing: {g_py} / {g_cc}"))
+        # Pass 3d: ctypes binding signatures vs the C exports of every
+        # translation unit in the shared library.
+        ct_py = args.store_py or os.path.join(
+            root, "ray_tpu", "core", "object_store.py")
+        ct_ccs = [os.path.join(root, "csrc", f)
+                  for f in ("object_store.cc", "store_server.cc",
+                            "copy_core.cc")]
+        ct_ccs_found = [p for p in ct_ccs if os.path.exists(p)]
+        if os.path.exists(ct_py) and ct_ccs_found:
+            findings += wire_schema.run_ctypes(
+                ct_py, ct_ccs_found,
+                os.path.relpath(ct_py, root).replace(os.sep, "/"),
+                [os.path.relpath(p, root).replace(os.sep, "/")
+                 for p in ct_ccs_found])
+        elif not explicit_paths:
+            findings.append(Finding(
+                "<wire>", 1, wire_schema.RULE, "error",
+                f"ctypes schema sources missing: {ct_py} / {ct_ccs}"))
 
     if args.rpc_root != "none":
         rpc_root = args.rpc_root or os.path.join(root, "ray_tpu")

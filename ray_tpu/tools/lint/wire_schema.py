@@ -555,3 +555,293 @@ def run_graft(py_path: str, cc_path: str, py_rel: str, cc_rel: str
         err(py_rel, f"frame cap drift: MAX_FRAME={py.max_frame} vs "
                     f"kMaxFrame={cc.max_frame}")
     return findings
+
+
+# ==========================================================================
+# Pass 3d — ctypes binding signatures vs C exports.
+#
+# Every native entry point is declared twice: the C definition in
+# csrc/*.cc and the ctypes restype/argtypes in
+# `ray_tpu/core/object_store.py::_load_lib`. A one-sided edit (an added
+# parameter, a widened size field, a handle return) produces silent
+# stack/register garbage at call time — ctypes cannot check it. This
+# pass re-derives both sides (AST for the _load_lib assignments, regex
+# over column-0 function definitions for C) and fails on arity drift,
+# per-argument width/pointerness drift, return-type drift, and the
+# nastiest default: a C function returning a pointer or 64-bit value
+# whose binding never sets restype (ctypes defaults to 4-byte c_int —
+# pointer truncation on 64-bit).
+# ==========================================================================
+
+# ctypes type -> (class, byte width). Pointers compare by class only.
+_CTYPES_CLASSES: Dict[str, Tuple[str, int]] = {
+    "c_void_p": ("ptr", 8), "c_char_p": ("ptr", 8), "c_wchar_p": ("ptr", 8),
+    "py_object": ("ptr", 8),
+    "c_bool": ("int", 1), "c_uint8": ("int", 1), "c_int8": ("int", 1),
+    "c_byte": ("int", 1), "c_ubyte": ("int", 1), "c_char": ("int", 1),
+    "c_uint16": ("int", 2), "c_int16": ("int", 2), "c_short": ("int", 2),
+    "c_ushort": ("int", 2),
+    "c_uint32": ("int", 4), "c_int32": ("int", 4), "c_int": ("int", 4),
+    "c_uint": ("int", 4),
+    "c_uint64": ("int", 8), "c_int64": ("int", 8), "c_size_t": ("int", 8),
+    "c_ssize_t": ("int", 8), "c_long": ("int", 8), "c_ulong": ("int", 8),
+    "c_longlong": ("int", 8), "c_ulonglong": ("int", 8),
+    "c_float": ("float", 4), "c_double": ("float", 8),
+}
+
+# Column-0 C function definition/declaration, params possibly wrapping.
+_C_FN_RE = re.compile(
+    r"^(?P<ret>(?:const\s+)?[A-Za-z_][A-Za-z0-9_]*\s*\**)\s*"
+    r"(?P<name>[A-Za-z_][A-Za-z0-9_]*)\s*\((?P<args>[^)]*)\)\s*[;{]",
+    re.M)
+
+
+def _fmt_class(cls: Tuple[str, int]) -> str:
+    kind, width = cls
+    if kind == "ptr":
+        return "pointer"
+    if kind == "void":
+        return "void"
+    return f"{width * 8}-bit {kind}"
+
+
+def _ctypes_class(node: ast.AST) -> Optional[Tuple[str, int]]:
+    """Width class of a ctypes type expression (ctypes.c_uint64,
+    POINTER(...), bare c_int)."""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) \
+            else getattr(fn, "id", "")
+        if name == "POINTER":
+            return ("ptr", 8)
+        return None
+    name = node.attr if isinstance(node, ast.Attribute) \
+        else getattr(node, "id", None)
+    if name is None:
+        return None
+    return _CTYPES_CLASSES.get(name)
+
+
+def _collect_binding_assigns(body, env: Dict[str, List[str]],
+                             sigs: Dict[str, dict],
+                             errors: List[str]) -> None:
+    """Walk _load_lib statements collecting `lib.NAME.restype/argtypes`
+    and the `for fn in ("a", "b"): getattr(lib, fn).x = ...` batch
+    idiom (env maps the loop variable to its literal names)."""
+    for stmt in body:
+        if isinstance(stmt, ast.For):
+            env2 = dict(env)
+            if (isinstance(stmt.target, ast.Name)
+                    and isinstance(stmt.iter, (ast.Tuple, ast.List))
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in stmt.iter.elts)):
+                env2[stmt.target.id] = [e.value for e in stmt.iter.elts]
+            _collect_binding_assigns(stmt.body, env2, sigs, errors)
+            continue
+        if isinstance(stmt, (ast.If, ast.With, ast.Try)):
+            _collect_binding_assigns(getattr(stmt, "body", []), env, sigs,
+                                     errors)
+            continue
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
+            continue
+        t = stmt.targets[0]
+        if not (isinstance(t, ast.Attribute)
+                and t.attr in ("restype", "argtypes")):
+            continue
+        base = t.value
+        fn_names: List[str] = []
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "lib"):
+            fn_names = [base.attr]
+        elif (isinstance(base, ast.Call)
+              and getattr(base.func, "id", "") == "getattr"
+              and len(base.args) == 2
+              and isinstance(base.args[1], ast.Name)):
+            fn_names = env.get(base.args[1].id, [])
+            if not fn_names:
+                errors.append(
+                    f"line {stmt.lineno}: cannot resolve "
+                    f"getattr(lib, {base.args[1].id}) to literal names")
+        else:
+            continue
+        for fname in fn_names:
+            sig = sigs.setdefault(fname, {"restype": None, "argtypes": None,
+                                          "line": stmt.lineno})
+            if t.attr == "restype":
+                cls = _ctypes_class(stmt.value)
+                if cls is None:
+                    errors.append(f"line {stmt.lineno}: unknown ctypes "
+                                  f"restype expression for {fname}")
+                else:
+                    sig["restype"] = cls
+            else:
+                if not isinstance(stmt.value, (ast.List, ast.Tuple)):
+                    errors.append(f"line {stmt.lineno}: argtypes for "
+                                  f"{fname} is not a literal list")
+                    continue
+                classes = []
+                for el in stmt.value.elts:
+                    cls = _ctypes_class(el)
+                    if cls is None:
+                        errors.append(f"line {stmt.lineno}: unknown "
+                                      f"ctypes argtype for {fname}")
+                        classes = None
+                        break
+                    classes.append(cls)
+                if classes is not None:
+                    sig["argtypes"] = classes
+
+
+def parse_ctypes_py(path: str) -> Tuple[Dict[str, dict], List[str]]:
+    errors: List[str] = []
+    sigs: Dict[str, dict] = {}
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    loader = next((n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n.name == "_load_lib"), None)
+    if loader is None:
+        errors.append("_load_lib not found")
+        return sigs, errors
+    _collect_binding_assigns(loader.body, {}, sigs, errors)
+    if not sigs:
+        errors.append("_load_lib declares no lib.*.restype/argtypes")
+    return sigs, errors
+
+
+def _c_param_class(param: str) -> Optional[Tuple[str, int]]:
+    param = param.strip()
+    if not param or param == "void" or param == "...":
+        return None
+    if "*" in param:
+        return ("ptr", 8)
+    toks = [t for t in re.split(r"\s+", param)
+            if t not in ("const", "struct", "volatile")]
+    if not toks:
+        return None
+    width = _C_TYPE_WIDTHS.get(toks[0])
+    if width is None:
+        return None
+    return ("int", width)
+
+
+def _c_ret_class(ret: str) -> Optional[Tuple[str, int]]:
+    ret = ret.strip()
+    if "*" in ret:
+        return ("ptr", 8)
+    tok = ret.replace("const", "").strip()
+    if tok == "void":
+        return ("void", 0)
+    width = _C_TYPE_WIDTHS.get(tok)
+    if width is None:
+        return None
+    return ("int", width)
+
+
+def parse_c_exports(path: str, rel: str, errors: List[Finding]
+                    ) -> Dict[str, Tuple[str, int, Tuple, List]]:
+    """name -> (rel, line, ret_class, [param_class]) for every column-0
+    function definition/declaration in the file. Anonymous-namespace
+    helpers also match; callers only consult bound names, so they are
+    inert."""
+    out: Dict[str, Tuple[str, int, Tuple, List]] = {}
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    for m in _C_FN_RE.finditer(text):
+        name = m.group("name")
+        line = text.count("\n", 0, m.start()) + 1
+        ret = _c_ret_class(m.group("ret"))
+        if ret is None:
+            continue  # not a function def (macro, template, etc.)
+        args_src = m.group("args").strip()
+        params: List[Tuple[str, int]] = []
+        bad = False
+        if args_src and args_src != "void":
+            for p in args_src.split(","):
+                cls = _c_param_class(p)
+                if cls is None:
+                    bad = True
+                    break
+                params.append(cls)
+        if bad:
+            continue  # unparsable param (function pointer etc.)
+        out[name] = (rel, line, ret, params)
+    return out
+
+
+def run_ctypes(py_path: str, cc_paths: List[str], py_rel: str,
+               cc_rels: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def err(path: str, line: int, msg: str) -> None:
+        findings.append(Finding(path, line, RULE, "error", msg))
+
+    py_sigs, py_errors = parse_ctypes_py(py_path)
+    for e in py_errors:
+        err(py_rel, 1, e)
+    if py_errors and not py_sigs:
+        return findings
+
+    # One C namespace across the shared library's translation units;
+    # later files may re-declare earlier files' exports (forward decls)
+    # — those must agree too.
+    c_sigs: Dict[str, List[Tuple[str, int, Tuple, List]]] = {}
+    for path, rel in zip(cc_paths, cc_rels):
+        for name, entry in parse_c_exports(path, rel, findings).items():
+            c_sigs.setdefault(name, []).append(entry)
+    for name, entries in sorted(c_sigs.items()):
+        if name not in py_sigs or len(entries) < 2:
+            continue
+        rel0, line0, ret0, params0 = entries[0]
+        for rel1, line1, ret1, params1 in entries[1:]:
+            if (ret1, params1) != (ret0, params0):
+                err(rel1, line1,
+                    f"C declaration of {name!r} disagrees with the one "
+                    f"at {rel0}:{line0}")
+
+    for fname in sorted(py_sigs):
+        sig = py_sigs[fname]
+        entries = c_sigs.get(fname)
+        if not entries:
+            err(py_rel, sig["line"],
+                f"ctypes binding {fname!r} has no C definition in "
+                f"{', '.join(cc_rels)}")
+            continue
+        c_rel, c_line, c_ret, c_params = entries[0]
+        py_args = sig["argtypes"]
+        if py_args is not None:
+            if len(py_args) != len(c_params):
+                err(py_rel, sig["line"],
+                    f"ctypes arity drift for {fname!r}: binding declares "
+                    f"{len(py_args)} argument(s), C takes "
+                    f"{len(c_params)} ({c_rel}:{c_line})")
+            else:
+                for i, (pa, ca) in enumerate(zip(py_args, c_params)):
+                    if pa != ca:
+                        err(py_rel, sig["line"],
+                            f"ctypes width drift for {fname!r} argument "
+                            f"{i}: binding passes {_fmt_class(pa)}, C "
+                            f"expects {_fmt_class(ca)} "
+                            f"({c_rel}:{c_line})")
+        py_ret = sig["restype"]
+        if py_ret is None:
+            # ctypes defaults restype to c_int (4 bytes): fine for
+            # void/int returns, silent truncation for anything wider.
+            if c_ret[0] == "ptr" or (c_ret[0] == "int" and c_ret[1] > 4):
+                err(py_rel, sig["line"],
+                    f"ctypes binding {fname!r} leaves restype at the "
+                    f"4-byte c_int default but C returns "
+                    f"{_fmt_class(c_ret)} ({c_rel}:{c_line}) — silent "
+                    f"truncation on 64-bit")
+        elif c_ret == ("void", 0):
+            err(py_rel, sig["line"],
+                f"ctypes binding {fname!r} sets restype but C returns "
+                f"void ({c_rel}:{c_line})")
+        elif py_ret != c_ret:
+            err(py_rel, sig["line"],
+                f"ctypes restype drift for {fname!r}: binding reads "
+                f"{_fmt_class(py_ret)}, C returns {_fmt_class(c_ret)} "
+                f"({c_rel}:{c_line})")
+    return findings

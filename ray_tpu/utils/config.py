@@ -76,6 +76,13 @@ _flag("object_spill_dir", str, "", "Directory for spilled objects (default: sess
 # --- dispatch plane (graftrpc) ---
 _flag("graftrpc", bool, True, "Native dispatch plane for the actor-call hot path: co-located workers exchange push_task_batch frames over the C reactor (csrc/rpc_core.cc) instead of the asyncio RpcServer; falls back to the asyncio path when off or the native library is unavailable.")
 
+# --- copy plane (graftcopy) ---
+_flag("graftcopy", bool, True, "Native put plane: fused sidecar OP_PUT (O_TMPFILE+linkat staging, oid-derived names) with large copies routed through the csrc/copy_core.cc scatter engine; falls back to the pwritev + OP_INGEST path when off or the native library is unavailable.")
+_flag("graftcopy_threads", int, 0, "Copy-engine worker threads for scatter writes; 0 = auto (host cores - 1, so 1-core hosts run sequentially on the calling thread).")
+_flag("graftcopy_min_bytes", int, 16 * 1024**2, "Route puts at least this large through the native scatter engine; smaller payloads use one os.pwritev (a pool handoff costs more than it saves).")
+_flag("put_executor_offload_bytes", int, 4 * 1024**2, "Loop-path puts larger than this copy on the default executor instead of the event loop; the same knob caps the legacy (graftcopy-off) synchronous fast-put path.")
+_flag("graftcopy_scratch_max_bytes", int, 2 * 1024**3, "Per-worker staging-inode recycling cap: the put plane keeps one private hardlink ('scratch-<pid>') to its last staging file so a delete drops only the store's name and the next put of at most this size rewrites the same hot tmpfs pages (cold page allocation halves write bandwidth); 0 disables recycling.")
+
 # --- scheduling ---
 _flag("scheduler_spread_threshold", float, 0.5, "Hybrid policy: pack below this utilization, then spread.")
 _flag("max_pending_lease_requests_per_class", int, 8, "Pipelined lease requests per scheduling class (aligned with worker_pool_max_idle_workers so steady-state bursts cause no worker churn).")

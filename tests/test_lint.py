@@ -577,3 +577,86 @@ def test_graft_schema_detects_struct_format_mismatch(tmp_path):
                   'struct.Struct("<BBIQ")', "graftrpc.py")
     fs = wire_schema.run_graft(py, GRAFT_CC, "py", "cc")
     assert fs, "format/width mismatch not detected"
+
+# ---------------------------------------------------------------------------
+# pass 3d — ctypes binding signatures vs C exports
+# ---------------------------------------------------------------------------
+
+OS_CC = os.path.join(REPO, "csrc", "object_store.cc")
+COPY_CC = os.path.join(REPO, "csrc", "copy_core.cc")
+CT_CCS = [OS_CC, STORE_CC, COPY_CC]
+CT_RELS = ["object_store.cc", "store_server.cc", "copy_core.cc"]
+
+
+def _ctypes_run(py=STORE_PY, ccs=None, rels=None):
+    return wire_schema.run_ctypes(py, ccs or CT_CCS, "py",
+                                  rels or CT_RELS)
+
+
+def test_ctypes_schema_repo_in_sync():
+    fs = _ctypes_run()
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_ctypes_schema_detects_arity_drift(tmp_path):
+    cc = _mutated(tmp_path, COPY_CC, "int copy_linkat(int src_fd, "
+                  "const char* dst)",
+                  "int copy_linkat(int src_fd, const char* dst, int flags)",
+                  "copy_core.cc")
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc])
+    assert fs and all(f.rule == "wire-drift" for f in fs)
+    assert any("arity" in f.message and "copy_linkat" in f.message
+               for f in fs), [f.render() for f in fs]
+
+
+def test_ctypes_schema_detects_arg_width_drift(tmp_path):
+    cc = _mutated(tmp_path, COPY_CC, "int nsegs)", "uint64_t nsegs)",
+                  "copy_core.cc")
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc])
+    assert fs and any("width" in f.message
+                      and "copy_write_scatter" in f.message
+                      for f in fs), [f.render() for f in fs]
+
+
+def test_ctypes_schema_detects_restype_drift(tmp_path):
+    cc = _mutated(tmp_path, COPY_CC, "int copy_engine_threads(",
+                  "uint64_t copy_engine_threads(", "copy_core.cc")
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc])
+    assert fs and any("restype" in f.message
+                      and "copy_engine_threads" in f.message
+                      for f in fs), [f.render() for f in fs]
+
+
+def test_ctypes_schema_detects_default_restype_truncation(tmp_path):
+    # Deleting a pointer-returning binding's restype leaves ctypes'
+    # 4-byte c_int default: the worst drift class (handle truncation).
+    py = _mutated(tmp_path, STORE_PY,
+                  "    lib.copy_engine_create.restype = ctypes.c_void_p\n",
+                  "", "object_store.py")
+    fs = _ctypes_run(py=py)
+    assert fs and any("truncation" in f.message
+                      and "copy_engine_create" in f.message
+                      for f in fs), [f.render() for f in fs]
+
+
+def test_ctypes_schema_detects_cross_file_decl_drift(tmp_path):
+    # store_server.cc forward-declares object_store.cc exports; a
+    # one-sided parameter change must be flagged.
+    cc = _mutated(tmp_path, STORE_CC,
+                  "int store_delete(void* handle, const char* id);",
+                  "int store_delete(void* handle, const char* id, "
+                  "int force);", "store_server.cc")
+    fs = _ctypes_run(ccs=[OS_CC, cc, COPY_CC],
+                     rels=["object_store.cc", "store_server.cc",
+                           "copy_core.cc"])
+    assert fs and any("disagrees" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_ctypes_schema_detects_missing_c_definition(tmp_path):
+    cc = _mutated(tmp_path, COPY_CC, "int copy_linkat(",
+                  "int copy_linkat_v2(", "copy_core.cc")
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc])
+    assert fs and any("no C definition" in f.message
+                      and "copy_linkat" in f.message
+                      for f in fs), [f.render() for f in fs]

@@ -115,6 +115,7 @@ def test_native_store_sanitizers():
                              cwd=os.path.abspath(CSRC),
                              capture_output=True, text=True, timeout=600)
         assert out.returncode == 0, (target, out.stdout + out.stderr)
-        # Both native planes run sanitized: the store sidecar suite AND
-        # the graftrpc reactor suite each print their own ALL OK.
-        assert out.stdout.count("ALL OK") >= 2, (target, out.stdout)
+        # All three native planes run sanitized: the store sidecar
+        # suite, the graftrpc reactor suite, AND the graftcopy engine
+        # suite each print their own ALL OK.
+        assert out.stdout.count("ALL OK") >= 3, (target, out.stdout)
