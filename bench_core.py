@@ -48,6 +48,20 @@ def emit(metric: str, value: float, unit: str) -> None:
     }), flush=True)
 
 
+def _best_rep(fn, reps: int) -> float:
+    """Fastest single repetition, in seconds. Burst metrics report
+    best-of-reps rather than the mean: on a shared/1-core host,
+    scheduler noise only ever *subtracts* throughput, so the minimum
+    time is the least-biased estimate of what the dispatch plane can
+    do (same reasoning as timeit's min)."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def timed_loop(fn, seconds: float = SECONDS) -> float:
     """Run fn repeatedly for ~seconds; return ops/sec."""
     # warmup
@@ -95,11 +109,7 @@ def bench_actor_calls_async():
 
     for _ in range(2):
         burst()
-    t0 = time.perf_counter()
-    reps = 3 if QUICK else 5
-    for _ in range(reps):
-        burst()
-    rate = batch * reps / (time.perf_counter() - t0)
+    rate = batch / _best_rep(burst, 3 if QUICK else 5)
     emit("1_1_actor_calls_async", rate, "calls/s")
     ray_tpu.kill(a)
 
@@ -178,11 +188,7 @@ def bench_put_gigabytes():
 
     put_one()
     before = _put_phases()
-    t0 = time.perf_counter()
-    reps = 2 if QUICK else 4
-    for _ in range(reps):
-        put_one()
-    gbps = nbytes * reps / (time.perf_counter() - t0) / 1024 ** 3
+    gbps = nbytes / _best_rep(put_one, 2 if QUICK else 4) / 1024 ** 3
     emit_put_phases("gigabytes", before, _put_phases())
     emit("single_client_put_gigabytes", gbps, "GiB/s")
 
@@ -210,14 +216,73 @@ def bench_n_n_actor_calls():
         ray_tpu.get(refs)
 
     burst()
-    t0 = time.perf_counter()
-    reps = 3
-    for _ in range(reps):
-        burst()
-    rate = n * batch * reps / (time.perf_counter() - t0)
+    rate = n * batch / _best_rep(burst, 4)
     emit("n_n_actor_calls_async", rate, "calls/s")
     for a in actors:
         ray_tpu.kill(a)
+
+
+# The two metrics most exposed to the graftscope flight recorder: the
+# n:n burst rides the graftrpc frame path (one scope_emit per frame
+# send/recv/flush) and put_gigabytes rides the graftcopy scatter path.
+_SCOPE_METRICS = ("n_n_actor_calls_async", "single_client_put_gigabytes")
+
+
+def _scope_subset() -> None:
+    """Child mode (--scope-subset): only the recorder-sensitive benches,
+    under whatever RAY_TPU_GRAFTSCOPE the parent set for this process
+    tree (workers and agent inherit it, so the whole plane is on/off)."""
+    os.environ.setdefault("RAY_TPU_WORKER_PRESTART", "12")
+    ray_tpu.init(resources={"CPU": 16})
+    try:
+        bench_n_n_actor_calls()
+        bench_put_gigabytes()
+    finally:
+        ray_tpu.shutdown()
+
+
+def bench_scope_delta() -> None:
+    """Recorder-on vs recorder-off, each in a fresh process tree (the
+    recorder lives in every worker/agent/sidecar, so an env flip on a
+    live cluster would only cover the driver). Emits the on/off rates
+    and the overhead percentage per metric — the always-on posture is
+    held to <3% here."""
+    import subprocess
+    rates: dict = {}
+    # Interleaved on/off/on/off, best-of per arm: a single A/B pair on
+    # this host class swings +/-25% with scheduler noise, and noise
+    # only ever lowers a rate — the per-arm maximum is what converges.
+    for flag in ("1", "0", "1", "0"):
+        env = dict(os.environ, RAY_TPU_GRAFTSCOPE=flag)
+        cmd = [sys.executable, os.path.abspath(__file__), "--scope-subset"]
+        if QUICK:
+            cmd.append("--quick")
+        out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                             timeout=900)
+        if out.returncode != 0:
+            print(json.dumps({"metric": "graftscope_overhead_pct",
+                              "error": out.stderr[-500:]}), flush=True)
+            return
+        for line in out.stdout.splitlines():
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if row.get("metric") in _SCOPE_METRICS:
+                arm = rates.setdefault(row["metric"], {})
+                arm[flag] = max(arm.get(flag, 0), row["value"])
+    for metric in _SCOPE_METRICS:
+        on, off = rates[metric].get("1"), rates[metric].get("0")
+        if not on or not off:
+            continue
+        print(json.dumps({
+            "metric": f"graftscope_overhead_{metric}",
+            # positive = recorder costs throughput; small negatives are
+            # run-to-run noise on this host class.
+            "value": round((off - on) / off * 100, 2), "unit": "pct",
+            "recorder_on": round(on, 2), "recorder_off": round(off, 2),
+            "budget_pct": 3.0, "host_cores": os.cpu_count(),
+        }), flush=True)
 
 
 def main() -> None:
@@ -235,17 +300,24 @@ def main() -> None:
         bench_get_calls()
         bench_put_gigabytes()
         bench_pg_create_removal()
-        print(json.dumps({
-            "metric": "_meta",
-            "note": "python bench_core.py (make bench-core regenerates "
-                    "BENCH_CORE.json); run-to-run variance on small CI "
-                    "VMs is +/-25%; put_gigabytes is bound by the raw "
-                    "tmpfs write ceiling",
-            "host_cores": os.cpu_count(),
-        }), flush=True)
     finally:
         ray_tpu.shutdown()
+    bench_scope_delta()
+    print(json.dumps({
+        "metric": "_meta",
+        "note": "python bench_core.py (make bench-core regenerates "
+                "BENCH_CORE.json); run-to-run variance on small CI "
+                "VMs is +/-25%; put_gigabytes is bound by the raw "
+                "tmpfs write ceiling; burst metrics report best-of-rep "
+                "(scheduler noise only subtracts throughput); "
+                "graftscope_overhead_* rows hold the always-on flight "
+                "recorder to its <3% budget",
+        "host_cores": os.cpu_count(),
+    }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--scope-subset" in sys.argv:
+        _scope_subset()
+    else:
+        main()

@@ -29,7 +29,10 @@ python -m pytest tests/test_ops.py tests/test_model_parallel.py \
     tests/test_autoscaler.py tests/test_jobs_util.py \
     tests/test_runtime_env_container.py -q
 
-echo "=== native store sanitizers ==="
+echo "=== native-plane sanitizers ==="
+# make tsan / make asan via the pytest wrapper: store sidecar, graftrpc
+# reactor, graftcopy engine, and the graftscope ring buffers (the
+# lock-free drain-while-writing storm runs under ThreadSanitizer here).
 RAY_TPU_SANITIZER_TESTS=1 python -m pytest \
     tests/test_native_store.py::test_native_store_sanitizers -q
 

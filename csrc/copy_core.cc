@@ -39,6 +39,8 @@
 #include <fcntl.h>
 #include <unistd.h>
 
+#include "scope_core.h"
+
 extern "C" {
 // One scatter segment: copy `len` bytes from `src` to file offset `off`.
 // Mirrored field-for-field by the ctypes CopySeg struct in
@@ -171,6 +173,19 @@ int copy_write_scatter(void* handle, int fd, const CopySeg* segs,
   auto* e = static_cast<Engine*>(handle);
   if (nsegs <= 0) return 0;
 
+  uint64_t t0 = scope_enabled() ? scope_now_ns() : 0;
+  // graftscope span-in-one on every exit: seq_or_oid = start_ns,
+  // t_ns = end_ns, size = bytes (u32-clipped), op = 1 on error.
+  auto scoped = [t0](uint64_t total, int rc) {
+    if (t0 != 0) {
+      uint64_t t1 = scope_now_ns();
+      uint32_t sz = total > 0xFFFFFFFFull ? 0xFFFFFFFFu : (uint32_t)total;
+      scope_emit(kScopeCopyScatter, rc == 0 ? 0 : 1, 0, sz, t0, t1,
+                 t1 - t0);
+    }
+    return rc;
+  };
+
   // Sequential path: no pool, or too little data to amortize a handoff.
   uint64_t total = 0;
   for (int i = 0; i < nsegs; i++) total += segs[i].len;
@@ -178,9 +193,9 @@ int copy_write_scatter(void* handle, int fd, const CopySeg* segs,
     for (int i = 0; i < nsegs; i++) {
       int rc = PwriteFull(fd, static_cast<const char*>(segs[i].src),
                           segs[i].len, segs[i].off);
-      if (rc != 0) return -rc;
+      if (rc != 0) return scoped(total, -rc);
     }
-    return 0;
+    return scoped(total, 0);
   }
 
   auto job = std::make_shared<Job>();
@@ -215,7 +230,7 @@ int copy_write_scatter(void* handle, int fd, const CopySeg* segs,
   while (job->done.load(std::memory_order_acquire) < job->chunks.size()) {
     e->cv_done.wait(lk);
   }
-  return -job->err.load();
+  return scoped(total, -job->err.load());
 }
 
 // Atomically link the (possibly anonymous O_TMPFILE) fd's file at dst.
@@ -223,10 +238,12 @@ int copy_write_scatter(void* handle, int fd, const CopySeg* segs,
 int copy_linkat(int src_fd, const char* dst) {
   char proc[64];
   std::snprintf(proc, sizeof proc, "/proc/self/fd/%d", src_fd);
+  int rc = 0;
   if (::linkat(AT_FDCWD, proc, AT_FDCWD, dst, AT_SYMLINK_FOLLOW) != 0) {
-    return errno ? -errno : -EIO;
+    rc = errno ? -errno : -EIO;
   }
-  return 0;
+  scope_emit(kScopeCopyLink, rc == 0 ? 0 : 1, 0, 0, 0, 0, 0);
+  return rc;
 }
 
 }  // extern "C"

@@ -56,6 +56,8 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include "scope_core.h"
+
 namespace {
 
 #pragma pack(push, 1)
@@ -127,6 +129,10 @@ void Notify(Endpoint* ep, InRec&& rec) {
     ep->inbox.push_back(std::move(rec));
   }
   if (was_empty) {
+    // graftscope: one wake record per empty->nonempty transition — the
+    // recv-side wakeup-batching ratio falls straight out of
+    // RpcRecv.calls / RpcWake.calls.
+    scope_emit(kScopeRpcWake, 0, 0, 0, 0, 0, 0);
     char b = 1;
     (void)!::write(ep->notify_w, &b, 1);
   }
@@ -227,6 +233,12 @@ bool ExtractFrames(Endpoint* ep, Conn* c) {
     rec.conn = c->id;
     rec.len = len;
     rec.data.assign(c->inbuf.data() + c->inoff + 4, len);
+    if (scope_enabled()) {
+      // Frame header leads the record data; peek it for the trace tag.
+      FrameHeader h;
+      std::memcpy(&h, rec.data.data(), sizeof(h));
+      scope_emit(kScopeRpcRecv, h.op, h.chan, len, h.seq, 0, 0);
+    }
     Notify(ep, std::move(rec));
     c->inoff += 4 + (size_t)len;
   }
@@ -264,14 +276,23 @@ void HandleReadable(Endpoint* ep, const std::shared_ptr<Conn>& c) {
 
 void HandleWritable(Endpoint* ep, const std::shared_ptr<Conn>& c) {
   bool fatal = false;
+  uint64_t t0 = scope_enabled() ? scope_now_ns() : 0;
+  size_t flushed = 0;
   {
     std::lock_guard<std::mutex> g(c->wmu);
     if (c->fd < 0) return;
+    size_t before = c->outbuf.size();
     if (!FlushLocked(c.get())) {
       fatal = true;
     } else if (c->outbuf.empty()) {
       SetEpollOut(ep, c.get(), false);
     }
+    flushed = before - c->outbuf.size();
+  }
+  if (t0 != 0 && flushed > 0) {
+    // Span-in-one record: seq_or_oid = start_ns, t_ns = end_ns.
+    uint64_t t1 = scope_now_ns();
+    scope_emit(kScopeRpcFlush, 0, 0, (uint32_t)flushed, t0, t1, t1 - t0);
   }
   if (fatal) CloseConn(ep, c, /*report=*/true);
 }
@@ -463,6 +484,13 @@ int rpc_core_send(void* handle, uint32_t conn, const char* data,
   if (len < (uint32_t)kFrameHeaderSize || len > kMaxFrame) return -1;
   auto c = FindConn(ep, conn);
   if (c == nullptr) return -1;
+  if (scope_enabled()) {
+    // Peek the header only — this plane never interprets payloads. The
+    // chan field carries the submitter's trace tag (graftscope.py).
+    FrameHeader h;
+    std::memcpy(&h, data, sizeof(h));
+    scope_emit(kScopeRpcSend, h.op, h.chan, len, h.seq, 0, 0);
+  }
   bool need_arm = false;
   {
     std::lock_guard<std::mutex> g(c->wmu);

@@ -1,0 +1,82 @@
+// graftscope: lock-free flight recorder for the native planes.
+//
+// Shared contract between the recorder (scope_core.cc), the instrumented
+// planes (rpc_core.cc, copy_core.cc, store_server.cc) and the Python
+// decoder (ray_tpu/core/_native/graftscope.py). The wire record layout
+// and the kind table below are lint-checked against the Python constants
+// (tools/lint/wire_schema.py pass 3e) — keep both sides in sync.
+//
+// Wire record (little-endian, fixed width):
+//   u8 kind | u8 op | u16 chan | u32 size | u64 seq_or_oid | u64 t_ns
+//
+// Span-in-one kinds carry their interval inside one record (no pairing
+// needed across thread rings):
+//   RpcFlush   : seq_or_oid = start_ns, t_ns = end_ns, size = bytes
+//   CopyScatter: seq_or_oid = start_ns, t_ns = end_ns, size = bytes
+//   ScEnd      : seq_or_oid = oid64,    t_ns = end_ns, size = dur_ns
+// Point kinds timestamp a single instant (t_ns), with seq_or_oid
+// carrying the frame seq (Rpc*) or the first 8 oid bytes (Sc*).
+
+#ifndef RAY_TPU_SCOPE_CORE_H_
+#define RAY_TPU_SCOPE_CORE_H_
+
+#include <cstdint>
+
+#pragma pack(push, 1)
+struct ScopeWireRec {  // 24 bytes on the wire, little-endian
+  uint8_t kind;
+  uint8_t op;
+  uint16_t chan;
+  uint32_t size;
+  uint64_t seq_or_oid;
+  uint64_t t_ns;
+};
+#pragma pack(pop)
+
+constexpr int kScopeRecordSize = 24;
+static_assert(sizeof(ScopeWireRec) == kScopeRecordSize, "record packing");
+
+// Record kinds. Mirrored by KIND_* in graftscope.py (lint pass 3e).
+[[maybe_unused]] constexpr uint8_t kScopeRpcSend = 1, kScopeRpcRecv = 2,
+                                   kScopeRpcFlush = 3, kScopeRpcWake = 4,
+                                   kScopeCopyScatter = 5, kScopeCopyLink = 6,
+                                   kScopeScAccept = 7, kScopeScBegin = 8,
+                                   kScopeScEnd = 9, kScopeScRename = 10;
+[[maybe_unused]] constexpr int kScopeKindCount = 11;  // 1 + highest kind
+
+extern "C" {
+
+// Hot-path emit: appends one record to the calling thread's ring and
+// bumps the per-kind counter block (calls += 1, bytes += size,
+// ns += dur_ns). t_ns == 0 means "stamp with scope_now_ns() here".
+// No-op (one relaxed load) while the recorder is disabled.
+void scope_emit(uint8_t kind, uint8_t op, uint16_t chan, uint32_t size,
+                uint64_t seq_or_oid, uint64_t t_ns, uint64_t dur_ns);
+
+// 1 while recording. Default comes from RAY_TPU_GRAFTSCOPE (unset/1 =
+// on, "0"/"false"/"off"/"no" = off), resolved once on first use.
+int scope_enabled(void);
+void scope_set_enabled(int on);
+
+// CLOCK_MONOTONIC in ns — system-wide on Linux, so records from every
+// process on a host share one clock domain.
+uint64_t scope_now_ns(void);
+
+// Drain every thread ring into buf as kScopeRecordSize-byte records.
+// Returns bytes written (a multiple of the record size). Safe against
+// concurrent writers and concurrent drainers (drain holds an internal
+// mutex; writers never block).
+int scope_drain(char* buf, int cap);
+
+// Copy the cumulative counter block: out[3k..3k+2] = {calls, bytes, ns}
+// for kind k. Writes min(max_kinds, kScopeKindCount) kinds; returns the
+// number written.
+int scope_counters(uint64_t* out, int max_kinds);
+
+// Records lost to ring wraparound or slot exhaustion since process
+// start.
+uint64_t scope_dropped(void);
+
+}  // extern "C"
+
+#endif  // RAY_TPU_SCOPE_CORE_H_

@@ -35,6 +35,11 @@
 //      7 DROP: fire-and-forget DELETE — processed and journaled like op 4
 //        but answered with NO reply frame; outcomes are reported via the
 //        counters on the next PUT/CONTAINS reply.
+//      8 SCOPE: drain this process's graftscope flight-recorder rings
+//        into the reply's path field (rc = plen = bytes, a whole number
+//        of 24-byte records; ds = records dropped so far, ms = recorder
+//        enabled flag). Touches no store state — observability only, so
+//        a slow scope reader never couples to the object data plane.
 
 #include <atomic>
 #include <cstdint>
@@ -50,6 +55,8 @@
 #include <sys/socket.h>
 #include <sys/un.h>
 #include <unistd.h>
+
+#include "scope_core.h"
 
 extern "C" {
 // From object_store.cc (same shared library).
@@ -68,7 +75,15 @@ namespace {
 constexpr int kIdSize = 20;
 constexpr uint8_t kOpIngest = 1, kOpGet = 2, kOpRelease = 3,
                   kOpDelete = 4, kOpContains = 5, kOpPut = 6,
-                  kOpDrop = 7;
+                  kOpDrop = 7, kOpScope = 8;
+
+// First 8 oid bytes as a little-endian u64 — enough entropy to match a
+// native record back to the Python-side object id during stitching.
+uint64_t Oid64(const char* oid) {
+  uint64_t v;
+  std::memcpy(&v, oid, 8);
+  return v;
+}
 
 struct Event {       // journal entry: 29 bytes packed on drain
   uint8_t op;        // kOpIngest | kOpDelete
@@ -167,6 +182,14 @@ void* ConnLoop(void* argp) {
     if (nlen && !ReadFull(fd, name, nlen)) break;
     name[nlen] = 0;
 
+    // SCOPE requests are not themselves recorded: a drain loop that
+    // produced a fresh record per drain would never run dry.
+    uint64_t svc_t0 =
+        scope_enabled() && op != kOpScope ? scope_now_ns() : 0;
+    if (svc_t0 != 0) {
+      uint32_t sz = a + b > 0xFFFFFFFFull ? 0xFFFFFFFFu : (uint32_t)(a + b);
+      scope_emit(kScopeScBegin, op, 0, sz, Oid64(oid), svc_t0, 0);
+    }
     int32_t rc = -1;
     uint64_t ds = 0, ms = 0;
     uint16_t plen = 0;
@@ -189,7 +212,16 @@ void* ConnLoop(void* argp) {
                                  /*pinned=*/1);
         // Journaled as an ingest either way: the agent's bookkeeping
         // (primary ledger, seal waiters) is op-agnostic.
-        if (rc == 0) Journal(s, kOpIngest, oid, a + b);
+        if (rc == 0) {
+          if (svc_t0 != 0) {
+            // The staging file just became the store object (rename-in).
+            scope_emit(kScopeScRename, op, 0,
+                       a + b > 0xFFFFFFFFull ? 0xFFFFFFFFu
+                                             : (uint32_t)(a + b),
+                       Oid64(oid), 0, 0);
+          }
+          Journal(s, kOpIngest, oid, a + b);
+        }
         if (op == kOpPut) {
           ds = drops_seen;
           ms = drops_erased;
@@ -205,6 +237,13 @@ void* ConnLoop(void* argp) {
         drops_seen++;
         if (store_delete(s->store, oid) == 0) drops_erased++;
         Journal(s, kOpDelete, oid, 0);
+        if (svc_t0 != 0) {
+          uint64_t t1 = scope_now_ns();
+          uint64_t d = t1 - svc_t0;
+          scope_emit(kScopeScEnd, op,
+                     0, d > 0xFFFFFFFFull ? 0xFFFFFFFFu : (uint32_t)d,
+                     Oid64(oid), t1, d);
+        }
         continue;
       case kOpGet:
         rc = store_get(s->store, oid, path, sizeof(path), &ds, &ms);
@@ -233,8 +272,28 @@ void* ConnLoop(void* argp) {
         ds = drops_seen;
         ms = drops_erased;
         break;
+      case kOpScope: {
+        // Drain the recorder into the path field: a whole number of
+        // records, bounded by the u16 plen (path cap 4096, NUL spare).
+        int m = scope_drain(path, (int)sizeof(path) - 1);
+        if (m < 0) m = 0;
+        rc = m;
+        plen = (uint16_t)m;
+        ds = scope_dropped();
+        ms = (uint64_t)scope_enabled();
+        break;
+      }
       default:
         rc = -5;
+    }
+    if (svc_t0 != 0) {
+      // Span-in-one: size carries the service duration (ns, clipped) so
+      // stitching needs no Begin/End pairing across thread rings.
+      uint64_t t1 = scope_now_ns();
+      uint64_t d = t1 - svc_t0;
+      scope_emit(kScopeScEnd, op,
+                 0, d > 0xFFFFFFFFull ? 0xFFFFFFFFu : (uint32_t)d,
+                 Oid64(oid), t1, d);
     }
     if (!WriteFull(fd, &rc, 4) || !WriteFull(fd, &ds, 8) ||
         !WriteFull(fd, &ms, 8) || !WriteFull(fd, &plen, 2) ||
@@ -274,6 +333,7 @@ void* AcceptLoop(void* argp) {
       ::close(fd);
       return nullptr;
     }
+    scope_emit(kScopeScAccept, 0, 0, 0, 0, 0, 0);
     auto* args = new ConnArgs{s, fd};
     s->active_conns.fetch_add(1);
     pthread_t t;

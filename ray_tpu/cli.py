@@ -90,8 +90,10 @@ def cmd_list(args) -> int:
 def cmd_timeline(args) -> int:
     _connect(args.address)
     from ray_tpu import state
-    trace = state.timeline(args.out)
-    print(f"wrote {len(trace)} trace events to {args.out}")
+    trace = state.timeline(args.out, native=args.native)
+    n_native = sum(1 for ev in trace if ev.get("cat") == "native")
+    extra = f" ({n_native} native spans)" if args.native else ""
+    print(f"wrote {len(trace)} trace events to {args.out}{extra}")
     return 0
 
 
@@ -215,6 +217,10 @@ def main(argv=None) -> int:
     sp = sub.add_parser("timeline")
     sp.add_argument("--address", required=True)
     sp.add_argument("--out", default="timeline.json")
+    sp.add_argument("--native", action="store_true",
+                    help="include graftscope native-plane spans "
+                         "(dispatch/wire/sidecar/copy) stitched under "
+                         "their submitting tasks")
     sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")

@@ -478,10 +478,16 @@ class GraftChannel:
         self._seq = 0
         self._pending: Dict[int, asyncio.Future] = {}
 
-    def call_batch(self, specs: list) -> asyncio.Future:
+    def call_batch(self, specs: list, chan: int = 0) -> asyncio.Future:
         """Send one CALL frame for the batch; the future resolves to the
         per-task reply dicts (same shape as push_task_batch's return).
-        Raises GraftSendError when nothing went on the wire."""
+        Raises GraftSendError when nothing went on the wire.
+
+        `chan` rides the otherwise-spare u16 header field as the
+        graftscope trace tag: the reactor records it on both sides of
+        the wire (RpcSend/RpcRecv) and the executor echoes it in the
+        REPLY, so the flight recorder can parent the native hops under
+        the submitting task's span without parsing any payload."""
         if self.closed or self.ep.closed:
             raise GraftSendError("graftrpc channel closed")
         interns, payload = encode_call(self, specs)
@@ -495,7 +501,7 @@ class GraftChannel:
         seq = self._seq
         fut = asyncio.get_running_loop().create_future()
         self._pending[seq] = fut
-        if not self.ep.send(self.conn, OP_CALL, seq, payload):
+        if not self.ep.send(self.conn, OP_CALL, seq, payload, chan=chan):
             self._pending.pop(seq, None)
             self.fail(RpcConnectionLost("graftrpc connection lost"))
             raise GraftSendError("graftrpc call send failed")

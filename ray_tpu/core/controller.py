@@ -124,6 +124,12 @@ class Controller:
         from collections import deque
         self.task_events: "deque" = deque(maxlen=50000)
         self.node_metrics: Dict[str, dict] = {}
+        # graftscope native spans (flight-recorder records stitched by
+        # workers/agents) + oid64 -> (trace_id, parent_span) learned
+        # from put-side spans, used to parent the agent's context-free
+        # sidecar spans in timeline().
+        self.native_spans: "deque" = deque(maxlen=50000)
+        self._oid_trace: Dict[int, tuple] = {}
         # Infeasible-demand signals, coalesced BY SHAPE (a parked lease
         # retries pick_node every ~250ms; raw per-attempt records would
         # multiply one pending task into dozens of demands and stampede
@@ -301,10 +307,47 @@ class Controller:
     async def list_task_events(self, limit: int = 1000) -> list:
         return list(self.task_events)[-limit:]
 
-    async def timeline(self) -> list:
+    async def report_native_spans(self, spans: list) -> None:
+        """graftscope spans from worker flushers / agent metric ticks.
+        Put-side spans teach us oid64 -> trace context; sidecar-side
+        spans for the same object arrive context-free from the agent
+        and get parented at timeline() time."""
+        for s in spans:
+            oid = s.get("oid64")
+            if oid and s.get("trace_id"):
+                self._oid_trace[oid] = (s["trace_id"],
+                                        s.get("parent_span", ""))
+        if len(self._oid_trace) > 100000:
+            # Bounded, FIFO-ish: drop the older half (insertion order).
+            for k in list(self._oid_trace)[:50000]:
+                del self._oid_trace[k]
+        self.native_spans.extend(spans)
+
+    async def native_latency(self) -> list:
+        """Hot-path latency rollup over the retained native spans, for
+        the dashboard table: per span name, count / mean / max µs."""
+        agg: Dict[str, list] = {}
+        for s in self.native_spans:
+            a = agg.setdefault(s["name"], [0, 0.0, 0.0])
+            d = float(s.get("dur", 0.0))
+            a[0] += 1
+            a[1] += d
+            if d > a[2]:
+                a[2] = d
+        return [{"name": n, "count": c, "mean_us": (su / c if c else 0.0),
+                 "max_us": mx}
+                for n, (c, su, mx) in sorted(agg.items())]
+
+    async def timeline(self, native: bool = True) -> list:
         """Chrome-trace events from the task ledger (reference:
-        `ray timeline`, _private/profiling.py chrome://tracing dump)."""
+        `ray timeline`, _private/profiling.py chrome://tracing dump),
+        plus — when ``native`` — the graftscope spans (dispatch-queue,
+        wire, sidecar-service, copy phases) re-homed onto the pid/tid
+        of the task that submitted them so viewers nest them under
+        that task's slice. Every event carries pid AND tid (Perfetto
+        drops track-less events)."""
         starts: Dict[str, dict] = {}
+        placed: Dict[str, tuple] = {}  # task_id -> (pid, tid)
         trace: list = []
         for ev in self.task_events:
             if ev["event"] == "submitted":
@@ -313,16 +356,46 @@ class Controller:
                 s = starts.pop(ev["task_id"], None)
                 if s is None:
                     continue
+                pid = ev.get("owner", "driver")
+                tid = ev["task_id"][:8]
+                placed[ev["task_id"]] = (pid, tid)
                 trace.append({
                     "name": ev.get("name", "task"),
                     "cat": "task",
                     "ph": "X",
                     "ts": s["ts"] * 1e6,
                     "dur": max(0.0, (ev["ts"] - s["ts"]) * 1e6),
-                    "pid": ev.get("owner", "driver"),
-                    "tid": ev["task_id"][:8],
-                    "args": {"status": ev["event"]},
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"status": ev["event"],
+                             "trace_id": ev.get("trace_id", ""),
+                             "parent_span": ev.get("parent_span", "")},
                 })
+        if not native:
+            return trace
+        for s in self.native_spans:
+            trace_id = s.get("trace_id", "")
+            parent = s.get("parent_span", "")
+            if not trace_id and s.get("oid64"):
+                ctx = self._oid_trace.get(s["oid64"])
+                if ctx is not None:
+                    trace_id, parent = ctx
+            # Home the span: the submitting task's track when we know
+            # it, else the reporting process's own native track.
+            home = placed.get(parent) or placed.get(trace_id)
+            pid, tid = home if home is not None else (
+                s.get("pid", "native"), s.get("tid", "native"))
+            args = dict(s.get("args") or {})
+            if trace_id:
+                args["trace_id"] = trace_id
+                args["parent_span"] = parent
+            if s.get("oid64"):
+                args["oid64"] = s["oid64"]
+            trace.append({
+                "name": s["name"], "cat": s.get("cat", "native"),
+                "ph": "X", "ts": s["ts"], "dur": s.get("dur", 0.0),
+                "pid": pid, "tid": tid, "args": args,
+            })
         return trace
 
     # ------------------------------------------------------------------

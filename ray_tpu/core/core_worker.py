@@ -283,6 +283,11 @@ class CoreWorker:
         self._graft_interns: Dict[int, dict] = {}    # serve side, per conn
         self._graft_no: set = set()  # peers with no graft listener
         self._graft_dialing: Dict[Any, Any] = {}  # single-flight discovery
+        # graftscope stitching (csrc/scope_core.cc): trace-tag assembler
+        # + spans buffered from user threads (list.append is GIL-atomic),
+        # flushed to the controller on the task-event flusher tick.
+        self._scope = None
+        self._scope_spans: list = []
         # Actor-dispatch wakeup coalescing: user threads append specs to
         # _actor_push_buf directly (GIL-atomic) and poke the drainer once
         # per burst — no per-call coroutine/Task/Future on the hot path.
@@ -398,6 +403,12 @@ class CoreWorker:
             except Exception as e:
                 logger.debug("graftrpc dispatch plane unavailable: %r", e)
                 self._graft = None
+        # Apply the graftscope config flag to the native recorder. The
+        # flag resolves override > RAY_TPU_GRAFTSCOPE env > default(on),
+        # mirroring the C side's lazy getenv — this call only matters
+        # for programmatic initialize() overrides.
+        from ray_tpu.core._native import graftscope
+        graftscope.configure_from_flags()
         spawn(self._task_event_flusher())
         if self.mode == "driver" and GlobalConfig.log_to_driver:
             # Worker prints stream to this driver (reference:
@@ -527,6 +538,50 @@ class CoreWorker:
         while True:
             await asyncio.sleep(2.0)
             self._flush_task_events()
+            self._flush_native_spans()
+
+    # ------------------------------------------------------------------
+    # graftscope stitching (owner-side; the native recorder's records
+    # become timeline spans here — see _native/graftscope.py)
+    # ------------------------------------------------------------------
+    def _scope_asm(self):
+        """This worker's SpanAssembler, or None while the recorder is
+        unavailable/disabled (checked per call: set_enabled can flip at
+        runtime; the check is one cached-ctypes C call)."""
+        from ray_tpu.core._native import graftscope
+        if not (graftscope.available() and graftscope.enabled()):
+            return None
+        if self._scope is None:
+            self._scope = graftscope.SpanAssembler(
+                "worker:" + self.worker_id.hex()[:8])
+        return self._scope
+
+    def _flush_native_spans(self) -> None:
+        """Drain this process's recorder rings, assemble spans, and ship
+        them (plus Python-timed put spans buffered by user threads) to
+        the controller. Rides the 2s task-event flusher tick so the hot
+        paths never touch span assembly."""
+        from ray_tpu.core._native import graftscope
+        asm = self._scope_asm()
+        if asm is None:
+            return
+        spans = asm.feed(graftscope.drain_records())
+        if self._scope_spans:
+            buf, self._scope_spans = self._scope_spans, []
+            spans.extend(buf)
+        # Worker-process counters (rpc send/flush, copy) fold into this
+        # process's metrics registry on the same tick.
+        graftscope.publish_counters()
+        if spans:
+            # Bound the batch: a controller outage must not turn the
+            # span buffer into a leak.
+            self._spawn(self._send_native_spans(spans[-5000:]))
+
+    async def _send_native_spans(self, spans: list) -> None:
+        try:
+            await self.controller.call("report_native_spans", spans)
+        except Exception:
+            pass  # observability is best-effort
 
     # ------------------------------------------------------------------
     # ownership ledger helpers
@@ -1302,6 +1357,8 @@ class CoreWorker:
         can evict/spill before bytes land) takes over."""
         phase = self._put_phase
         sdir = self._store_dir_cache
+        asm = self._scope_asm()
+        w0 = time.time_ns() if asm is not None else 0
         t0 = time.perf_counter_ns()
         try:
             name = self._write_put_file(sdir, oid, sv, meta)
@@ -1314,6 +1371,7 @@ class CoreWorker:
             # unsupported mid-flight: fall back (create+seal admission
             # evicts/spills BEFORE any bytes land).
             return False
+        w1 = time.time_ns() if asm is not None else 0
         t1 = time.perf_counter_ns()
         phase["copy"] += t1 - t0
         path = os.path.join(sdir, name)
@@ -1332,6 +1390,22 @@ class CoreWorker:
             # Full (-2) or rename failure: the RPC path can spill.
             self._drop_staged(path, oid)
             return False
+        if asm is not None:
+            # Put-plane spans carry the oid64 key AND the ambient trace
+            # context: the controller learns oid64 -> context here and
+            # uses it to parent the sidecar-side service spans for the
+            # same object (which arrive from the agent context-free).
+            ctx = getattr(_trace_local, "ctx", None)
+            if ctx is None:
+                ctx = _trace_ctxvar.get()
+            tid = ctx[0].hex() if ctx else ""
+            par = ctx[1].hex() if ctx and ctx[1] else \
+                (ctx[0].hex() if ctx else "")
+            w2 = time.time_ns()
+            self._scope_spans.append(asm.put_span(
+                "put.copy", w0, w1, oid, tid, par, sv.total_size))
+            self._scope_spans.append(asm.put_span(
+                "put.ingest", w1, w2, oid, tid, par, sv.total_size))
         e = self._entry(oid, create=True)
         e.creating_task = None
         e.contained = []
@@ -2885,7 +2959,7 @@ class CoreWorker:
             if ch is not None:
                 ch.on_reply(seq, flags, payload)
         elif op == graftrpc.OP_CALL:
-            spawn(self._serve_graft_call(conn, seq, payload))
+            spawn(self._serve_graft_call(conn, chan, seq, payload))
         elif op == graftrpc.OP_INTERN:
             graftrpc.intern_frame_apply(
                 payload, self._graft_interns.setdefault(conn, {}))
@@ -2949,12 +3023,15 @@ class CoreWorker:
         self._graft_chan_by_conn[conn] = ch
         return ch
 
-    async def _serve_graft_call(self, conn: int, seq: int,
+    async def _serve_graft_call(self, conn: int, chan: int, seq: int,
                                 payload: bytes) -> None:
         """Executor side of one OP_CALL frame. Failures that escape the
         per-task reply shape (codec drift, unknown intern id) come back
         as a whole-batch FLAG_ERR — the caller fails the batch hard
-        rather than retrying what may have half-executed."""
+        rather than retrying what may have half-executed. ``chan`` is
+        the caller's graftscope trace tag: echoing it on the REPLY lets
+        the caller's flight recorder pair the two frames into a wire
+        span (graftscope.SpanAssembler)."""
         from ray_tpu.core._native import graftrpc
         try:
             specs = graftrpc.decode_call(
@@ -2970,7 +3047,8 @@ class CoreWorker:
                                    protocol=5)
             flags = graftrpc.FLAG_ERR
         if self._graft is not None:
-            self._graft.send(conn, graftrpc.OP_REPLY, seq, out, flags=flags)
+            self._graft.send(conn, graftrpc.OP_REPLY, seq, out, flags=flags,
+                             chan=chan)
 
     # Max actor tasks coalesced into one push_task_batch RPC. Batching
     # amortizes the per-RPC cost (framing, dedup, task spawn, reply hop)
@@ -3124,8 +3202,21 @@ class CoreWorker:
         chan = await self._graft_channel_for(client)
         if chan is not None:
             from ray_tpu.core._native.graftrpc import GraftSendError
+            # Lease a graftscope trace tag so the recorder's SEND/RECV
+            # records for this batch stitch into dispatch + wire spans
+            # under the submitting task (the tag rides the frame
+            # header's spare chan field; the executor echoes it).
+            tag = 0
+            asm = self._scope_asm()
+            if asm is not None:
+                s0 = specs[0]
+                parent = s0.parent_span or s0.task_id
+                tag = asm.lease_tag(
+                    s0.trace_id.hex() if s0.trace_id else "",
+                    parent.hex() if parent else "",
+                    s0.name, len(specs))
             try:
-                return await chan.call_batch(specs)
+                return await chan.call_batch(specs, chan=tag)
             except GraftSendError:
                 pass
         blobs = [pickle.dumps(spec, protocol=5) for spec in specs]

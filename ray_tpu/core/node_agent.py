@@ -251,6 +251,10 @@ class NodeAgent:
             except Exception as e:
                 logger.warning("store fast path disabled: %r", e)
                 self._fastpath = None
+        # The sidecar threads above record into this process's
+        # graftscope rings; apply the config flag before they get busy.
+        from ray_tpu.core._native import graftscope
+        graftscope.configure_from_flags()
         self._sock_path = os.path.join(self.session_dir,
                                        f"agent-{self.port}.sock")
         try:
@@ -360,6 +364,12 @@ class NodeAgent:
                           "objects spilled to disk")
         workers = M.Gauge("raytpu_workers", "worker processes alive")
         leases = M.Gauge("raytpu_active_leases", "granted worker leases")
+        # graftscope: the sidecar's recorder rings live in THIS process
+        # (store_server.cc threads), so the agent's tick is where
+        # sidecar service/rename records become timeline spans and the
+        # counter block becomes metric deltas (amortization point).
+        from ray_tpu.core._native import graftscope
+        scope_asm = None
         period = max(0.5, GlobalConfig.metrics_report_period_ms / 1000)
         last_sweep = 0.0
         while not self._shutdown:
@@ -394,6 +404,15 @@ class NodeAgent:
                 except OSError:
                     pass
             try:
+                if graftscope.available() and graftscope.enabled():
+                    graftscope.publish_counters()
+                    if scope_asm is None:
+                        scope_asm = graftscope.SpanAssembler(
+                            "agent:" + self.node_id.hex()[:12])
+                    spans = scope_asm.feed(graftscope.drain_records())
+                    if spans:
+                        await self.controller.call(
+                            "report_native_spans", spans[-5000:])
                 store_used.set(self.store.used())
                 store_objs.set(self.store.num_objects())
                 spilled.set(self.num_spilled)

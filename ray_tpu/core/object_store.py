@@ -122,6 +122,22 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_int]
     lib.copy_linkat.restype = ctypes.c_int
     lib.copy_linkat.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    # graftscope flight recorder (scope_core.cc).
+    lib.scope_emit.argtypes = [
+        ctypes.c_uint8, ctypes.c_uint8, ctypes.c_uint16, ctypes.c_uint32,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+    lib.scope_enabled.restype = ctypes.c_int
+    lib.scope_enabled.argtypes = []
+    lib.scope_set_enabled.argtypes = [ctypes.c_int]
+    lib.scope_now_ns.restype = ctypes.c_uint64
+    lib.scope_now_ns.argtypes = []
+    lib.scope_drain.restype = ctypes.c_int
+    lib.scope_drain.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.scope_counters.restype = ctypes.c_int
+    lib.scope_counters.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+    lib.scope_dropped.restype = ctypes.c_uint64
+    lib.scope_dropped.argtypes = []
     return lib
 
 
@@ -293,6 +309,7 @@ class FastStoreClient:
     OP_INGEST, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS = 1, 2, 3, 4, 5
     OP_PUT = 6
     OP_DROP = 7
+    OP_SCOPE = 8
 
     def __init__(self, sock_path: str):
         import threading
@@ -442,6 +459,25 @@ class FastStoreClient:
         rc, ds, ms, _ = self._req(self.OP_CONTAINS, oid)
         self._settle_drops(ds, ms)
         return rc
+
+    def scope_drain(self) -> Tuple[bytes, int, bool]:
+        """Drain the SIDECAR process's graftscope rings over the wire
+        (OP_SCOPE): -> (records, dropped_total, enabled). Records are
+        whole 24-byte graftscope wire records; decode with
+        ray_tpu.core._native.graftscope. Touches no store state, so a
+        scope reader never contends with the object data plane. The
+        reply is binary — bypasses `_req`'s NUL-terminated path decode."""
+        with self._lock:
+            if self._fd < 0:
+                self._reconnect_locked()
+            ok = self._lib.store_client_request(
+                self._fd, self.OP_SCOPE, b"\x00" * 20, 0, 0, None,
+                ctypes.byref(self._rc), ctypes.byref(self._ds),
+                ctypes.byref(self._ms), self._path, 4096)
+            if ok != 0:
+                self._fail_locked()
+            n = max(0, self._rc.value)
+            return self._path.raw[:n], self._ds.value, bool(self._ms.value)
 
     def close(self) -> None:
         if self._fd >= 0:

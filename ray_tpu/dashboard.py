@@ -7,6 +7,8 @@ React client is out of scope). Endpoints:
     GET /                -> minimal HTML overview
     GET /api/summary     -> cluster summary JSON
     GET /api/nodes|actors|tasks|workers|jobs
+    GET /api/timeline    -> Chrome-trace JSON incl. graftscope native spans
+    GET /api/native      -> native hot-path latency rollup (graftscope)
     GET /metrics         -> Prometheus text exposition
 
 Run via `python -m ray_tpu.cli dashboard --address H:P [--port 8265]`
@@ -43,11 +45,13 @@ _PAGE = """<!doctype html>
 <h3>Actors</h3><table id="actors"></table>
 <h3>Workers</h3><table id="workers"></table>
 <h3>Task summary</h3><table id="tasks"></table>
+<h3>Native hot paths (graftscope)</h3><table id="native"></table>
 <h3>Jobs</h3><table id="jobs"></table>
 <p class="muted">raw: <a href="/api/summary">summary</a> ·
 <a href="/api/nodes">nodes</a> · <a href="/api/actors">actors</a> ·
 <a href="/api/tasks">tasks</a> · <a href="/api/workers">workers</a> ·
-<a href="/api/jobs">jobs</a> · <a href="/metrics">metrics</a></p>
+<a href="/api/jobs">jobs</a> · <a href="/api/native">native</a> ·
+<a href="/api/timeline">timeline</a> · <a href="/metrics">metrics</a></p>
 <script>
 const fmt = v => typeof v === "number" && !Number.isInteger(v)
     ? v.toFixed(2) : v;
@@ -72,8 +76,9 @@ function usage(total, avail) {
 }
 async function tick() {
   try {
-    const [s, nodes, actors, tasks, workers, jobs] = await Promise.all(
-      ["summary","nodes","actors","tasks","workers","jobs"].map(
+    const [s, nodes, actors, tasks, workers, jobs, native] =
+      await Promise.all(
+      ["summary","nodes","actors","tasks","workers","jobs","native"].map(
         p => fetch("/api/" + p).then(r => r.json())));
     document.getElementById("summary").textContent =
       `nodes ${s.nodes_alive}/${s.nodes_total} · actors ${s.actors} · ` +
@@ -98,6 +103,8 @@ async function tick() {
       ["event","count"], (t, c) => t[c]);
     table("workers", workers, Object.keys(workers[0] || {}),
       (w, c) => fmt(w[c]));
+    table("native", native, ["name","count","mean_us","max_us"],
+      (r, c) => fmt(r[c]));
     table("jobs", jobs, ["job_id","status","entrypoint"],
       (j, c) => j[c] ?? "");
     document.getElementById("ts").textContent =
@@ -138,6 +145,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "/api/actors": state.list_actors,
                 "/api/tasks": state.list_tasks,
                 "/api/workers": state.list_workers,
+                "/api/timeline": state.timeline,
+                "/api/native": state.native_latency,
             }
             if self.path == "/api/jobs":
                 from ray_tpu import job_submission

@@ -8,6 +8,7 @@ api.py list_nodes/list_actors/list_tasks + dashboard/state_aggregator.py;
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List, Optional
 
 from ray_tpu import api as _api
@@ -81,14 +82,27 @@ def metrics_text() -> str:
     return _ctl("metrics_text")
 
 
-def timeline(filename: Optional[str] = None) -> List[dict]:
-    """Chrome-trace events for every recorded task; pass filename to dump
-    JSON loadable in chrome://tracing / Perfetto (reference:
-    `ray timeline`)."""
-    trace = _ctl("timeline")
+def native_latency() -> List[dict]:
+    """Hot-path latency rollup over the graftscope native spans the
+    controller retains: per span name (rpc.wire, sidecar.put, ...),
+    count / mean µs / max µs."""
+    return _ctl("native_latency")
+
+
+def timeline(filename: Optional[str] = None,
+             native: bool = True) -> List[dict]:
+    """Chrome-trace events for every recorded task — plus, with
+    ``native`` (default), the graftscope native-plane spans (dispatch,
+    wire, sidecar service, copy) nested under the submitting task. Pass
+    filename to dump JSON loadable in chrome://tracing / Perfetto
+    (reference: `ray timeline`). The dump is atomic (tmp + rename): a
+    crash or concurrent reader never sees a torn file."""
+    trace = _ctl("timeline", native)
     if filename:
-        with open(filename, "w") as f:
+        tmp = filename + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(trace, f)
+        os.replace(tmp, filename)
     return trace
 
 

@@ -60,6 +60,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--graft-cc", default=None,
                     help="C side of the graftrpc frame schema "
                          "(default: csrc/rpc_core.cc)")
+    ap.add_argument("--scope-py", default=None,
+                    help="Python side of the graftscope record schema "
+                         "(default: ray_tpu/core/_native/graftscope.py)")
+    ap.add_argument("--scope-cc", default=None,
+                    help="C side of the graftscope record schema "
+                         "(default: csrc/scope_core.h)")
     ap.add_argument("--rpc-root", default=None,
                     help="root scanned for RPC call sites/handlers "
                          "(default: ray_tpu/); 'none' disables")
@@ -115,13 +121,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings.append(Finding(
                 "<wire>", 1, wire_schema.RULE, "error",
                 f"graftrpc schema sources missing: {g_py} / {g_cc}"))
+        # Pass 3e: graftscope flight-recorder record schema.
+        s_py = args.scope_py or os.path.join(
+            root, "ray_tpu", "core", "_native", "graftscope.py")
+        s_cc = args.scope_cc or os.path.join(root, "csrc", "scope_core.h")
+        if os.path.exists(s_py) and os.path.exists(s_cc):
+            findings += wire_schema.run_scope(
+                s_py, s_cc,
+                os.path.relpath(s_py, root).replace(os.sep, "/"),
+                os.path.relpath(s_cc, root).replace(os.sep, "/"))
+        elif args.scope_py or args.scope_cc or not explicit_paths:
+            findings.append(Finding(
+                "<wire>", 1, wire_schema.RULE, "error",
+                f"graftscope schema sources missing: {s_py} / {s_cc}"))
         # Pass 3d: ctypes binding signatures vs the C exports of every
         # translation unit in the shared library.
         ct_py = args.store_py or os.path.join(
             root, "ray_tpu", "core", "object_store.py")
         ct_ccs = [os.path.join(root, "csrc", f)
                   for f in ("object_store.cc", "store_server.cc",
-                            "copy_core.cc")]
+                            "copy_core.cc", "scope_core.cc")]
         ct_ccs_found = [p for p in ct_ccs if os.path.exists(p)]
         if os.path.exists(ct_py) and ct_ccs_found:
             findings += wire_schema.run_ctypes(

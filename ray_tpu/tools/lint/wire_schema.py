@@ -845,3 +845,204 @@ def run_ctypes(py_path: str, cc_paths: List[str], py_rel: str,
                 f"{_fmt_class(py_ret)}, C returns {_fmt_class(c_ret)} "
                 f"({c_rel}:{c_line})")
     return findings
+
+
+# ==========================================================================
+# Pass 3e — graftscope flight-recorder record drift.
+#
+# The 24-byte recorder record is hand-duplicated: kind numbers + field
+# layout live in `ray_tpu/core/_native/graftscope.py` (KIND_*,
+# SCOPE_RECORD_FIELDS, SCOPE_RECORD struct format, SCOPE_RECORD_SIZE)
+# and again in `csrc/scope_core.h` (kScope* kind constants, packed
+# struct ScopeWireRec, kScopeRecordSize). Drift here corrupts every
+# decoded span/counter silently (records still parse — into garbage),
+# so re-derive both sides and fail on any mismatch: kind name/value,
+# field name/width/order, record size.
+# ==========================================================================
+
+def _camel_to_upper_snake(name: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).upper()
+
+
+def _scope_py_name(c_kind: str) -> str:
+    """kScopeRpcSend -> KIND_RPC_SEND; kScopeKindCount -> KIND_COUNT
+    (the snake form already starts with KIND_)."""
+    snake = _camel_to_upper_snake(c_kind)
+    return snake if snake.startswith("KIND_") else "KIND_" + snake
+
+
+class ScopePySchema:
+    def __init__(self) -> None:
+        self.kinds: Dict[str, int] = {}              # KIND_RPC_SEND -> 1
+        self.record_fields: List[Tuple[str, int]] = []
+        self.struct_widths: List[int] = []           # from "<BBHIQQ"
+        self.record_size: Optional[int] = None
+
+
+def parse_scope_py(path: str) -> Tuple[ScopePySchema, List[str]]:
+    errors: List[str] = []
+    schema = ScopePySchema()
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1 \
+                or not isinstance(stmt.targets[0], ast.Name):
+            continue
+        name, val = stmt.targets[0].id, stmt.value
+        if name.startswith("KIND_"):
+            if isinstance(val, (ast.Dict, ast.List, ast.Set)):
+                continue  # lookup tables (KIND_NAMES), not kind values
+            v = _const_int(val)
+            if v is None:
+                errors.append(f"cannot evaluate {name}")
+            else:
+                schema.kinds[name] = v
+        elif name == "SCOPE_RECORD_FIELDS":
+            if not isinstance(val, ast.Tuple):
+                errors.append("SCOPE_RECORD_FIELDS is not a tuple")
+                continue
+            for el in val.elts:
+                if (isinstance(el, ast.Tuple) and len(el.elts) == 2
+                        and isinstance(el.elts[0], ast.Constant)):
+                    w = _const_int(el.elts[1])
+                    if w is None:
+                        errors.append("SCOPE_RECORD_FIELDS: bad width")
+                        continue
+                    schema.record_fields.append((el.elts[0].value, w))
+                else:
+                    errors.append("SCOPE_RECORD_FIELDS: bad entry shape")
+        elif name == "SCOPE_RECORD":
+            if (isinstance(val, ast.Call) and val.args
+                    and isinstance(val.args[0], ast.Constant)):
+                fmt = val.args[0].value
+                for ch in str(fmt).lstrip("<>=!@"):
+                    w = _STRUCT_CHAR_WIDTHS.get(ch)
+                    if w is None:
+                        errors.append(
+                            f"SCOPE_RECORD: unknown format char {ch!r}")
+                    else:
+                        schema.struct_widths.append(w)
+            else:
+                errors.append("SCOPE_RECORD is not struct.Struct(<literal>)")
+        elif name == "SCOPE_RECORD_SIZE":
+            schema.record_size = _const_int(val)
+            if schema.record_size is None:
+                errors.append("cannot evaluate SCOPE_RECORD_SIZE")
+    if not schema.kinds:
+        errors.append("no KIND_* constants found")
+    if not schema.record_fields:
+        errors.append("SCOPE_RECORD_FIELDS not found")
+    if not schema.struct_widths:
+        errors.append("SCOPE_RECORD struct format not found")
+    return schema, errors
+
+
+class ScopeCSchema:
+    def __init__(self) -> None:
+        self.kinds: Dict[str, int] = {}              # RpcSend -> 1
+        self.record_fields: List[Tuple[str, int]] = []
+        self.record_size: Optional[int] = None
+
+
+def parse_scope_c(path: str) -> Tuple[ScopeCSchema, List[str]]:
+    errors: List[str] = []
+    schema = ScopeCSchema()
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+
+    for m in re.finditer(r"kScope([A-Za-z0-9_]+)\s*=\s*(\d+)", text):
+        if m.group(1) == "RecordSize":
+            continue  # layout constant, not a kind
+        schema.kinds[m.group(1)] = int(m.group(2))
+    if not schema.kinds:
+        errors.append("no kScope* kind constants found")
+
+    m = re.search(r"constexpr\s+int\s+kScopeRecordSize\s*=\s*(\d+)\s*;",
+                  text)
+    if m:
+        schema.record_size = int(m.group(1))
+    else:
+        errors.append("kScopeRecordSize constexpr not found")
+
+    m = re.search(r"struct\s+ScopeWireRec\s*\{(.*?)\};", text, re.S)
+    if not m:
+        errors.append("struct ScopeWireRec not found")
+    else:
+        for fm in re.finditer(
+                r"^\s*([A-Za-z_][A-Za-z0-9_]*)\s+([A-Za-z_][A-Za-z0-9_]*)"
+                r"\s*;", m.group(1), re.M):
+            ctype, fname = fm.group(1), fm.group(2)
+            width = _C_TYPE_WIDTHS.get(ctype)
+            if width is None:
+                errors.append(f"struct ScopeWireRec: unknown type {ctype}")
+                continue
+            schema.record_fields.append((fname, width))
+        if not schema.record_fields:
+            errors.append("struct ScopeWireRec has no parsable fields")
+    return schema, errors
+
+
+def run_scope(py_path: str, cc_path: str, py_rel: str, cc_rel: str
+              ) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def err(path: str, msg: str) -> None:
+        findings.append(Finding(path, 1, RULE, "error", msg))
+
+    py, py_errors = parse_scope_py(py_path)
+    cc, cc_errors = parse_scope_c(cc_path)
+    for e in py_errors:
+        err(py_rel, e)
+    for e in cc_errors:
+        err(cc_rel, e)
+    if py_errors or cc_errors:
+        return findings
+
+    # 1. Kind tables: same names (under the mechanical rename), same
+    #    values.
+    cc_kinds = {_scope_py_name(k): v for k, v in cc.kinds.items()}
+    for name in sorted(set(py.kinds) | set(cc_kinds)):
+        if name not in py.kinds:
+            err(py_rel, f"scope kind {name!r} exists in C (kScope*) but "
+                        f"has no KIND_* constant in graftscope.py")
+        elif name not in cc_kinds:
+            err(cc_rel, f"scope kind {name!r} exists in Python (KIND_*) "
+                        f"but has no kScope* constant")
+        elif py.kinds[name] != cc_kinds[name]:
+            err(py_rel, f"scope kind {name!r} drift: Python "
+                        f"{py.kinds[name]} vs C {cc_kinds[name]}")
+
+    # 2. Record layout: field-by-field name/width/order.
+    if len(py.record_fields) != len(cc.record_fields):
+        err(py_rel, f"scope record drift: Python declares "
+                    f"{len(py.record_fields)} fields, C struct has "
+                    f"{len(cc.record_fields)}")
+    for (pn, pw), (cn, cw) in zip(py.record_fields, cc.record_fields):
+        if pn != cn:
+            err(py_rel, f"scope record field order drift: Python has "
+                        f"{pn!r} where C has {cn!r}")
+        elif pw != cw:
+            err(py_rel, f"scope record field {pn!r} width drift: Python "
+                        f"{pw} vs C {cw}")
+
+    # 3. Struct format chars vs the declared field widths.
+    declared = [w for _, w in py.record_fields]
+    if py.struct_widths != declared:
+        err(py_rel, f"SCOPE_RECORD format widths {py.struct_widths} != "
+                    f"SCOPE_RECORD_FIELDS widths {declared}")
+
+    # 4. Record size: both constants and both layouts must agree.
+    psum = sum(w for _, w in py.record_fields)
+    csum = sum(w for _, w in cc.record_fields)
+    if py.record_size is not None and psum != py.record_size:
+        err(py_rel, f"SCOPE_RECORD_FIELDS pack to {psum} bytes but "
+                    f"SCOPE_RECORD_SIZE={py.record_size}")
+    if cc.record_size is not None and csum != cc.record_size:
+        err(cc_rel, f"struct ScopeWireRec packs to {csum} bytes but "
+                    f"kScopeRecordSize={cc.record_size}")
+    if py.record_size is not None and cc.record_size is not None \
+            and py.record_size != cc.record_size:
+        err(py_rel, f"scope record size drift: SCOPE_RECORD_SIZE="
+                    f"{py.record_size} vs kScopeRecordSize="
+                    f"{cc.record_size}")
+    return findings

@@ -129,6 +129,7 @@ _flag("log_to_driver", bool, True, "Stream worker stdout/stderr lines to the dri
 _flag("event_stats_enabled", bool, True, "Record per-handler event-loop stats.")
 _flag("task_events_batch_size", int, 1000, "Task events per batch sent to controller.")
 _flag("metrics_report_period_ms", int, 5000, "Metrics push period.")
+_flag("graftscope", bool, True, "Native-plane flight recorder (graftscope): per-thread ring buffers in the graftrpc/graftcopy/sidecar hot paths, drained into metrics and the stitched timeline. RAY_TPU_GRAFTSCOPE=0 disables recording everywhere (Python seam and C planes read the same env).")
 
 
 class Config:

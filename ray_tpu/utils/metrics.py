@@ -6,10 +6,16 @@ the per-node agent to Prometheus). Here: components record into the
 process registry; node agents push snapshots to the controller every
 ``metrics_report_period_ms``; the controller aggregates and renders a
 Prometheus-style text exposition for scraping/CLI.
+
+Locking: the module lock guards only the registry map (create/list);
+every metric carries its own lock for value updates, so two components
+recording different metrics never contend — the reference's stats layer
+makes the same split between metric registration and recording.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -26,6 +32,7 @@ class Metric:
         self.description = description
         self.tag_keys = tuple(tag_keys)
         self._values: Dict[Tuple, float] = {}
+        self._mlock = threading.Lock()
         with _lock:
             _registry[name] = self
 
@@ -34,7 +41,7 @@ class Metric:
         return tuple(tags.get(k, "") for k in self.tag_keys)
 
     def snapshot(self) -> List[Tuple[Tuple, float]]:
-        with _lock:
+        with self._mlock:
             return list(self._values.items())
 
 
@@ -44,7 +51,7 @@ class Counter(Metric):
     def inc(self, value: float = 1.0,
             tags: Optional[Dict[str, str]] = None) -> None:
         k = self._key(tags)
-        with _lock:
+        with self._mlock:
             self._values[k] = self._values.get(k, 0.0) + value
 
 
@@ -53,7 +60,7 @@ class Gauge(Metric):
 
     def set(self, value: float,
             tags: Optional[Dict[str, str]] = None) -> None:
-        with _lock:
+        with self._mlock:
             self._values[self._key(tags)] = float(value)
 
 
@@ -75,18 +82,17 @@ class Histogram(Metric):
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
         k = self._key(tags)
-        with _lock:
+        with self._mlock:
             b = self._buckets.setdefault(
                 k, [0] * (len(self.boundaries) + 1))
-            i = 0
-            while i < len(self.boundaries) and value > self.boundaries[i]:
-                i += 1
-            b[i] += 1
+            # Bucket = count of boundaries strictly below value, i.e. the
+            # first bucket whose upper bound (inclusive) admits it.
+            b[bisect.bisect_left(self.boundaries, value)] += 1
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._counts[k] = self._counts.get(k, 0) + 1
 
     def snapshot(self):
-        with _lock:
+        with self._mlock:
             return [(k, {"buckets": list(v),
                          "boundaries": list(self.boundaries),
                          "sum": self._sums.get(k, 0.0),
@@ -103,6 +109,18 @@ def snapshot_all() -> Dict[str, dict]:
             for m in metrics}
 
 
+def _escape_label(v) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote and newline must be escaped or the line is unparseable."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v) -> str:
+    """HELP text escaping (backslash and newline only, per the format)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def render_prometheus(per_node: Dict[str, Dict[str, dict]]) -> str:
     """{node_hex: snapshot_all()} -> Prometheus text exposition."""
     lines: List[str] = []
@@ -110,13 +128,13 @@ def render_prometheus(per_node: Dict[str, Dict[str, dict]]) -> str:
     for node, snap in sorted(per_node.items()):
         for name, m in sorted(snap.items()):
             if name not in seen_help:
-                lines.append(f"# HELP {name} {m['description']}")
+                lines.append(f"# HELP {name} {_escape_help(m['description'])}")
                 lines.append(f"# TYPE {name} {m['kind']}")
                 seen_help.add(name)
             for tags_tuple, value in m["values"]:
-                tag_parts = [f'node="{node}"'] + [
-                    f'{k}="{v}"' for k, v in zip(m["tag_keys"],
-                                                 tags_tuple)]
+                tag_parts = [f'node="{_escape_label(node)}"'] + [
+                    f'{k}="{_escape_label(v)}"'
+                    for k, v in zip(m["tag_keys"], tags_tuple)]
                 tag_str = "{" + ",".join(tag_parts) + "}"
                 if m["kind"] == "histogram":
                     bounds = value.get("boundaries") or []

@@ -584,8 +584,10 @@ def test_graft_schema_detects_struct_format_mismatch(tmp_path):
 
 OS_CC = os.path.join(REPO, "csrc", "object_store.cc")
 COPY_CC = os.path.join(REPO, "csrc", "copy_core.cc")
-CT_CCS = [OS_CC, STORE_CC, COPY_CC]
-CT_RELS = ["object_store.cc", "store_server.cc", "copy_core.cc"]
+SCOPE_CORE_CC = os.path.join(REPO, "csrc", "scope_core.cc")
+CT_CCS = [OS_CC, STORE_CC, COPY_CC, SCOPE_CORE_CC]
+CT_RELS = ["object_store.cc", "store_server.cc", "copy_core.cc",
+           "scope_core.cc"]
 
 
 def _ctypes_run(py=STORE_PY, ccs=None, rels=None):
@@ -603,7 +605,7 @@ def test_ctypes_schema_detects_arity_drift(tmp_path):
                   "const char* dst)",
                   "int copy_linkat(int src_fd, const char* dst, int flags)",
                   "copy_core.cc")
-    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc])
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, SCOPE_CORE_CC, cc])
     assert fs and all(f.rule == "wire-drift" for f in fs)
     assert any("arity" in f.message and "copy_linkat" in f.message
                for f in fs), [f.render() for f in fs]
@@ -612,7 +614,7 @@ def test_ctypes_schema_detects_arity_drift(tmp_path):
 def test_ctypes_schema_detects_arg_width_drift(tmp_path):
     cc = _mutated(tmp_path, COPY_CC, "int nsegs)", "uint64_t nsegs)",
                   "copy_core.cc")
-    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc])
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, SCOPE_CORE_CC, cc])
     assert fs and any("width" in f.message
                       and "copy_write_scatter" in f.message
                       for f in fs), [f.render() for f in fs]
@@ -621,7 +623,7 @@ def test_ctypes_schema_detects_arg_width_drift(tmp_path):
 def test_ctypes_schema_detects_restype_drift(tmp_path):
     cc = _mutated(tmp_path, COPY_CC, "int copy_engine_threads(",
                   "uint64_t copy_engine_threads(", "copy_core.cc")
-    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc])
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, SCOPE_CORE_CC, cc])
     assert fs and any("restype" in f.message
                       and "copy_engine_threads" in f.message
                       for f in fs), [f.render() for f in fs]
@@ -656,7 +658,68 @@ def test_ctypes_schema_detects_cross_file_decl_drift(tmp_path):
 def test_ctypes_schema_detects_missing_c_definition(tmp_path):
     cc = _mutated(tmp_path, COPY_CC, "int copy_linkat(",
                   "int copy_linkat_v2(", "copy_core.cc")
-    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc])
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, SCOPE_CORE_CC, cc])
     assert fs and any("no C definition" in f.message
                       and "copy_linkat" in f.message
                       for f in fs), [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# pass 3e — graftscope flight-recorder record drift
+# ---------------------------------------------------------------------------
+
+SCOPE_PY = os.path.join(REPO, "ray_tpu", "core", "_native", "graftscope.py")
+SCOPE_CC = os.path.join(REPO, "csrc", "scope_core.h")
+
+
+def test_scope_schema_repo_in_sync():
+    fs = wire_schema.run_scope(SCOPE_PY, SCOPE_CC, "py", "cc")
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_scope_schema_detects_kind_value_drift(tmp_path):
+    cc = _mutated(tmp_path, SCOPE_CC, "kScopeCopyScatter = 5",
+                  "kScopeCopyScatter = 12", "scope_core.h")
+    fs = wire_schema.run_scope(SCOPE_PY, cc, "py", "cc")
+    assert fs and all(f.rule == "wire-drift" for f in fs)
+    assert any("COPY_SCATTER" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_scope_schema_detects_missing_kind(tmp_path):
+    cc = _mutated(tmp_path, SCOPE_CC, "kScopeScRename = 10",
+                  "kScopeScRelink = 10", "scope_core.h")
+    fs = wire_schema.run_scope(SCOPE_PY, cc, "py", "cc")
+    assert any("SC_RELINK" in f.message or "SC_RENAME" in f.message
+               for f in fs), [f.render() for f in fs]
+
+
+def test_scope_schema_detects_field_width_drift(tmp_path):
+    cc = _mutated(tmp_path, SCOPE_CC, "uint32_t size;", "uint64_t size;",
+                  "scope_core.h")
+    fs = wire_schema.run_scope(SCOPE_PY, cc, "py", "cc")
+    assert fs and any("size" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_scope_schema_detects_field_order_drift(tmp_path):
+    py = _mutated(tmp_path, SCOPE_PY, '("op", 1),\n    ("chan", 2),',
+                  '("chan", 2),\n    ("op", 1),', "graftscope.py")
+    fs = wire_schema.run_scope(py, SCOPE_CC, "py", "cc")
+    assert fs and any("order" in f.message or "op" in f.message
+                      for f in fs), [f.render() for f in fs]
+
+
+def test_scope_schema_detects_record_size_drift(tmp_path):
+    py = _mutated(tmp_path, SCOPE_PY, "SCOPE_RECORD_SIZE = 24",
+                  "SCOPE_RECORD_SIZE = 32", "graftscope.py")
+    fs = wire_schema.run_scope(py, SCOPE_CC, "py", "cc")
+    assert fs and any("size" in f.message.lower() for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_scope_schema_detects_struct_format_mismatch(tmp_path):
+    py = _mutated(tmp_path, SCOPE_PY, 'struct.Struct("<BBHIQQ")',
+                  'struct.Struct("<BBHQQQ")', "graftscope.py")
+    fs = wire_schema.run_scope(py, SCOPE_CC, "py", "cc")
+    assert fs, "format/width mismatch not detected"
