@@ -1,13 +1,19 @@
 # Developer entry points. CI runs ci.sh (which includes `make lint`'s
 # invocation verbatim); these targets are the pieces, runnable alone.
 
-.PHONY: lint test fast native native-test bench-core
+.PHONY: lint lint-native test fast native native-test bench-core
 
 # graftlint: framework-aware static analysis (event-loop safety, lock
-# discipline, Python<->C wire-schema drift, RPC signature drift, leaks).
+# discipline, Python<->C wire-schema drift, RPC signature drift, leaks,
+# store-protocol state machine, csrc memory orders + error-path fds).
 #   python -m ray_tpu.tools.lint --list-passes   for the pass list
 lint:
 	python -m ray_tpu.tools.lint
+
+# Just the native-plane passes (4b memory-order, 4c fd-leak) — the ones
+# to re-run in a tight loop while editing csrc/.
+lint-native:
+	python -m ray_tpu.tools.lint --native-only
 
 fast:
 	python -m pytest tests/ -m fast -q

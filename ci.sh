@@ -9,8 +9,10 @@ cd "$(dirname "$0")"
 
 echo "=== static analysis ==="
 # graftlint: event-loop safety, lock discipline, Python<->C wire-schema
-# drift, RPC handler-signature drift, task/coroutine leaks. Gates the
-# control plane (ray_tpu/core, serve, data) + csrc/store_server.cc.
+# drift, RPC handler-signature drift, task/coroutine leaks — plus the
+# graftgate passes: store-protocol state machine vs tools/lint/
+# protocol.json (4a), csrc memory-order discipline (4b), error-path
+# fd/inode leaks (4c). First gate: nothing else runs if this fails.
 python -m ray_tpu.tools.lint
 
 echo "=== stage 1: fast suite ==="

@@ -92,8 +92,14 @@ void RunChunks(Job* j) {
     int rc = PwriteFull(j->fd, static_cast<const char*>(c.src), c.len,
                         c.off);
     if (rc != 0) {
+      // Relaxed is enough: the err CAS is sequenced before our
+      // done.fetch_add(acq_rel) below, and the waiter only reads err
+      // after done.load(acquire) observes the final count — the done
+      // release sequence carries the err value across.
       int expected = 0;
-      j->err.compare_exchange_strong(expected, rc);
+      j->err.compare_exchange_strong(expected, rc,
+                                     std::memory_order_relaxed,
+                                     std::memory_order_relaxed);
     }
     j->done.fetch_add(1, std::memory_order_acq_rel);
   }
@@ -230,7 +236,8 @@ int copy_write_scatter(void* handle, int fd, const CopySeg* segs,
   while (job->done.load(std::memory_order_acquire) < job->chunks.size()) {
     e->cv_done.wait(lk);
   }
-  return scoped(total, -job->err.load());
+  // Ordered by the done.load(acquire) above; see RunChunks.
+  return scoped(total, -job->err.load(std::memory_order_relaxed));
 }
 
 // Atomically link the (possibly anonymous O_TMPFILE) fd's file at dst.

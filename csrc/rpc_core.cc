@@ -156,7 +156,8 @@ void CloseConn(Endpoint* ep, const std::shared_ptr<Conn>& c, bool report) {
   {
     std::lock_guard<std::mutex> g(c->wmu);
     if (c->fd < 0) return;  // already closed
-    c->dead.store(true);
+    // dead is only ever touched under wmu: relaxed, the mutex orders it.
+    c->dead.store(true, std::memory_order_relaxed);
     ::epoll_ctl(ep->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
     ::close(c->fd);
     c->fd = -1;
@@ -207,7 +208,7 @@ void RegisterConn(Endpoint* ep, const std::shared_ptr<Conn>& c) {
     ev.events = EPOLLIN | (arm ? (uint32_t)EPOLLOUT : 0u);
     ev.data.u64 = c->id;
     if (::epoll_ctl(ep->epfd, EPOLL_CTL_ADD, c->fd, &ev) != 0) {
-      c->dead.store(true);
+      c->dead.store(true, std::memory_order_relaxed);  // under wmu
       ::close(c->fd);
       c->fd = -1;
       failed = true;
@@ -390,13 +391,22 @@ extern "C" {
 // pipe's read end (register with the event loop, then rpc_core_drain).
 void* rpc_core_start(const char* listen_path, int* notify_fd_out) {
   auto* ep = new Endpoint();
-  if (MakePipe(&ep->wake_r, &ep->wake_w, true) != 0 ||
-      MakePipe(&ep->notify_r, &ep->notify_w, true) != 0) {
+  if (MakePipe(&ep->wake_r, &ep->wake_w, true) != 0) {
+    delete ep;
+    return nullptr;
+  }
+  if (MakePipe(&ep->notify_r, &ep->notify_w, true) != 0) {
+    ::close(ep->wake_r);
+    ::close(ep->wake_w);
     delete ep;
     return nullptr;
   }
   ep->epfd = ::epoll_create1(0);
   if (ep->epfd < 0) {
+    ::close(ep->wake_r);
+    ::close(ep->wake_w);
+    ::close(ep->notify_r);
+    ::close(ep->notify_w);
     delete ep;
     return nullptr;
   }
@@ -494,7 +504,7 @@ int rpc_core_send(void* handle, uint32_t conn, const char* data,
   bool need_arm = false;
   {
     std::lock_guard<std::mutex> g(c->wmu);
-    if (c->fd < 0 || c->dead.load()) return -1;
+    if (c->fd < 0 || c->dead.load(std::memory_order_relaxed)) return -1;
     bool was_idle = c->outbuf.empty();
     char prefix[4];
     std::memcpy(prefix, &len, 4);
@@ -507,7 +517,7 @@ int rpc_core_send(void* handle, uint32_t conn, const char* data,
       msg.msg_iovlen = 2;
       ssize_t w = ::sendmsg(c->fd, &msg, MSG_NOSIGNAL);
       if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
-        c->dead.store(true);
+        c->dead.store(true, std::memory_order_relaxed);  // under wmu
         return -1;
       }
       size_t wrote = w > 0 ? (size_t)w : 0;
@@ -577,7 +587,9 @@ void rpc_core_close_conn(void* handle, uint32_t conn) {
 // Stop the reactor and free everything. Must not race rpc_core_send.
 void rpc_core_stop(void* handle) {
   auto* ep = static_cast<Endpoint*>(handle);
-  ep->stopping.store(true);
+  // No reader pairs with this: stop is actually signaled via kCmdStop +
+  // pthread_join below. Relaxed keeps the vestigial flag honest.
+  ep->stopping.store(true, std::memory_order_relaxed);
   {
     std::lock_guard<std::mutex> g(ep->mu);
     ep->cmds.push_back(Cmd{kCmdStop, 0});
@@ -596,7 +608,7 @@ void rpc_core_stop(void* handle) {
       ::close(c->fd);
       c->fd = -1;
     }
-    c->dead.store(true);
+    c->dead.store(true, std::memory_order_relaxed);  // under wmu
   }
   if (ep->listen_fd >= 0) ::close(ep->listen_fd);
   ::close(ep->epfd);

@@ -317,7 +317,10 @@ void* ConnLoop(void* argp) {
     }
   }
   ::close(fd);
-  s->active_conns.fetch_sub(1);
+  // acq_rel: the final fetch_sub publishes this thread's last touches
+  // of *s to the acquire loads in store_server_stop, which may delete
+  // the Server the moment the count hits zero.
+  s->active_conns.fetch_sub(1, std::memory_order_acq_rel);
   return nullptr;
 }
 
@@ -326,21 +329,23 @@ void* AcceptLoop(void* argp) {
   for (;;) {
     int fd = ::accept(s->listen_fd, nullptr, nullptr);
     if (fd < 0) {
-      if (s->stopping) return nullptr;
+      // acquire pairs with stop()'s release store: everything stop()
+      // did before raising the flag is visible here.
+      if (s->stopping.load(std::memory_order_acquire)) return nullptr;
       continue;
     }
-    if (s->stopping.load()) {
+    if (s->stopping.load(std::memory_order_acquire)) {
       ::close(fd);
       return nullptr;
     }
     scope_emit(kScopeScAccept, 0, 0, 0, 0, 0, 0);
     auto* args = new ConnArgs{s, fd};
-    s->active_conns.fetch_add(1);
+    s->active_conns.fetch_add(1, std::memory_order_acq_rel);
     pthread_t t;
     if (pthread_create(&t, nullptr, ConnLoop, args) == 0) {
       pthread_detach(t);
     } else {
-      s->active_conns.fetch_sub(1);
+      s->active_conns.fetch_sub(1, std::memory_order_acq_rel);
       ::close(fd);
       delete args;
     }
@@ -416,7 +421,7 @@ int store_server_drain(void* handle, char* buf, int cap) {
 
 void store_server_stop(void* handle) {
   auto* s = static_cast<Server*>(handle);
-  s->stopping.store(true);
+  s->stopping.store(true, std::memory_order_release);
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   pthread_join(s->accept_thread, nullptr);
@@ -427,12 +432,17 @@ void store_server_stop(void* handle) {
     std::lock_guard<std::mutex> g(s->mu);
     for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
   }
-  for (int spins = 0; s->active_conns.load() > 0 && spins < 5000; spins++) {
+  // acquire pairs with ConnLoop's final fetch_sub(acq_rel): observing 0
+  // means every handler's last touch of *s happened-before the delete.
+  for (int spins = 0;
+       s->active_conns.load(std::memory_order_acquire) > 0 &&
+       spins < 5000;
+       spins++) {
     ::usleep(1000);
   }
   ::close(s->notify_r);
   ::close(s->notify_w);
-  if (s->active_conns.load() == 0) {
+  if (s->active_conns.load(std::memory_order_acquire) == 0) {
     delete s;  // else: leak one Server rather than risk a UAF
   }
 }

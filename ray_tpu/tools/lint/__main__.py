@@ -16,8 +16,8 @@ import os
 import sys
 from typing import List, Optional
 
-from ray_tpu.tools.lint import event_loop, leaks, locks, rpc_signatures, \
-    wire_schema
+from ray_tpu.tools.lint import event_loop, leaks, locks, memorder, \
+    protocol, resource_paths, rpc_signatures, wire_schema
 from ray_tpu.tools.lint.common import (Finding, SourceFile, iter_py_files,
                                        load_allowlist, load_source)
 
@@ -69,6 +69,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--rpc-root", default=None,
                     help="root scanned for RPC call sites/handlers "
                          "(default: ray_tpu/); 'none' disables")
+    ap.add_argument("--protocol", default=protocol.DEFAULT_PROTOCOL,
+                    help="checked protocol state-machine artifact "
+                         "(default: tools/lint/protocol.json)")
+    ap.add_argument("--no-protocol", action="store_true",
+                    help="skip the protocol state-machine pass (4a)")
+    ap.add_argument("--native-only", action="store_true",
+                    help="run only the native passes: memory-order "
+                         "discipline (4b) + error-path fd leaks (4c)")
     ap.add_argument("--allowlist", default=_DEFAULT_ALLOWLIST,
                     help="committed allowlist file")
     ap.add_argument("--json", action="store_true",
@@ -81,14 +89,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("locks       await-under-lock + lock-order inversions")
         print("wire        Python<->C store schema + RPC arity drift")
         print("leaks       un-awaited coroutines, orphaned tasks")
+        print("protocol    store op state machine vs protocol.json (4a)")
+        print("memorder    atomics memory-order discipline in csrc (4b)")
+        print("fd-leak     error-path close/unlink coverage in csrc (4c)")
         return 0
 
     root = os.path.abspath(args.root)
     explicit_paths = bool(args.paths)
+    allow = load_allowlist(args.allowlist)
+
+    def native_cc_files():
+        csrc = os.path.join(root, "csrc")
+        names = []
+        if os.path.isdir(csrc):
+            names = sorted(n for n in os.listdir(csrc)
+                           if n.endswith((".cc", ".h"))
+                           and "_test" not in n)
+        return [(os.path.join(csrc, n), f"csrc/{n}") for n in names]
+
+    if args.native_only:
+        findings = memorder.run(native_cc_files())
+        findings += resource_paths.run(native_cc_files())
+        kept = [f for f in findings if not allow.allows(f)]
+        kept.sort(key=lambda f: (f.path, f.line, f.rule))
+        if args.json:
+            print(json.dumps([f.__dict__ for f in kept], indent=2))
+        else:
+            for f in kept:
+                print(f.render())
+            print(f"graftlint (native): {len(kept)} finding(s) "
+                  f"({len(findings) - len(kept)} allowlisted)",
+                  file=sys.stderr)
+        return 1 if kept else 0
+
     paths = [p if os.path.isabs(p) else os.path.join(root, p)
              for p in (args.paths or _DEFAULT_PATHS)]
     files = _load(paths, root)
-    allow = load_allowlist(args.allowlist)
 
     findings: List[Finding] = []
     findings += event_loop.run(files)
@@ -153,6 +189,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "<wire>", 1, wire_schema.RULE, "error",
                 f"ctypes schema sources missing: {ct_py} / {ct_ccs}"))
 
+    # Pass 4a: store op protocol state machine vs the committed
+    # artifact (tools/lint/protocol.json). Walks the canonical client
+    # files only — receiver inference is tuned for them.
+    if not args.no_wire and not args.no_protocol:
+        cc_path = args.store_cc or os.path.join(
+            root, "csrc", "store_server.cc")
+        walk: List[SourceFile] = []
+        for rel in protocol.WALK_FILES:
+            p = os.path.join(root, rel.replace("/", os.sep))
+            sf = load_source(p, root) if os.path.exists(p) else None
+            if sf is not None:
+                walk.append(sf)
+        if os.path.exists(cc_path) and walk:
+            findings += protocol.run(
+                args.protocol, cc_path,
+                os.path.relpath(cc_path, root).replace(os.sep, "/"),
+                walk)
+        elif not explicit_paths:
+            findings.append(Finding(
+                "<protocol>", 1, protocol.RULE_DRIFT, "error",
+                f"protocol pass sources missing: {cc_path} / "
+                f"{', '.join(protocol.WALK_FILES)}"))
+
+    # Passes 4b/4c: memory-order + error-path fd discipline over the
+    # native planes (skipped when linting explicit fixture paths).
+    if not explicit_paths:
+        cc_files = native_cc_files()
+        if cc_files:
+            findings += memorder.run(cc_files)
+            findings += resource_paths.run(cc_files)
+
     if args.rpc_root != "none":
         rpc_root = args.rpc_root or os.path.join(root, "ray_tpu")
         rpc_files = _load([rpc_root], root)
@@ -174,9 +241,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         for f in kept:
             print(f.render())
-        for path, rule, qual, reason in allow.unused():
+        for path, rule, qual, expiry, reason in allow.unused():
             print(f"note: unused allowlist entry {path}:{rule}:{qual} "
-                  f"({reason})", file=sys.stderr)
+                  f"(expires {expiry}; {reason})", file=sys.stderr)
         n_suppressed = len(findings) - len(kept)
         print(f"graftlint: {len(kept)} finding(s) "
               f"({n_suppressed} allowlisted) across {len(files)} files",
