@@ -42,6 +42,9 @@ struct ObjectEntry {
   bool sealed = false;
   bool pinned = false;          // primary copy: never evict
   bool pending_delete = false;  // delete once refcount drops to 0
+  // graftshm: payload lives in an arena slab (stable "shmslab-*" name,
+  // never renamed); on erase the file is recycled, not unlinked.
+  bool slab_backed = false;
   int64_t refcount = 0;
   // LRU bookkeeping: valid iff evictable (sealed, refcount==0, !pinned).
   std::list<std::string>::iterator lru_it;
@@ -67,6 +70,12 @@ struct Store {
   std::condition_variable trash_cv;
   std::thread reaper;
   bool stopping = false;
+  // graftshm: where slab-backed payload files go on erase (the arena's
+  // free list) instead of unlink. Set under mu via
+  // store_set_slab_recycler; the callback only takes the arena mutex,
+  // so the store.mu -> arena.mu order is acyclic.
+  void (*slab_recycler)(void*, const char*, uint64_t) = nullptr;
+  void* slab_recycler_ctx = nullptr;
 };
 
 std::string IdKey(const char* id) { return std::string(id, kIdSize); }
@@ -128,6 +137,19 @@ void EraseObject(Store* s, const std::string& key,
   if (it == s->objects.end()) return;
   LruRemove(s, &it->second);
   s->used -= it->second.data_size + it->second.meta_size;
+  if (it->second.slab_backed && s->slab_recycler != nullptr) {
+    // graftshm slabs are recycled (warm pages, stable name), never
+    // unlinked here. Recycling is a free-list push; the rare over-cap
+    // unlink inside the recycler is a cheap tmpfs metadata op, so
+    // holding mu across it does not stall the admission path the way
+    // a GiB-scale page-freeing unlink would.
+    std::string spath = it->second.path;
+    uint64_t total = it->second.data_size + it->second.meta_size;
+    s->objects.erase(it);
+    s->slab_recycler(s->slab_recycler_ctx, spath.c_str(), total);
+    if (out_unlink != nullptr) out_unlink->clear();
+    return;
+  }
   const std::string& path = it->second.path;
   if (out_unlink != nullptr) {
     *out_unlink = path;
@@ -327,19 +349,24 @@ int store_release(void* handle, const char* id) {
 int store_delete(void* handle, const char* id) {
   auto* s = static_cast<Store*>(handle);
   std::string doomed;
+  bool erased = false;
   {
     std::lock_guard<std::mutex> g(s->mu);
     std::string key = IdKey(id);
     auto it = s->objects.find(key);
     if (it == s->objects.end()) return -1;
     if (it->second.refcount == 0) {
+      // doomed stays empty for slab-backed entries (the slab was
+      // recycled, not unlinked) — track the erase separately so the
+      // rc still says "gone NOW".
       EraseObject(s, key, &doomed);
+      erased = true;
     } else {
       it->second.pending_delete = true;
     }
   }
-  if (doomed.empty()) return 1;
-  ::unlink(doomed.c_str());
+  if (!erased) return 1;
+  if (!doomed.empty()) ::unlink(doomed.c_str());
   return 0;
 }
 
@@ -366,6 +393,61 @@ int store_pin(void* handle, const char* id, int pinned) {
     LruPush(s, it->first, &e);
   }
   return 0;
+}
+
+// graftshm: admit a STAGED (unsealed) entry whose payload is a
+// store-owned arena slab. No rename — the slab path IS the object path
+// for the rest of its life, so the client's CREATE-time mapping stays
+// coherent through seal and every later get (same inode). Staged
+// entries are invisible to LRU/eviction until sealed, exactly like
+// store_create_object's. 0 ok, -1 already exists, -2 out of memory
+// (after eviction).
+int store_adopt_staged(void* handle, const char* id, const char* slab_path,
+                       uint64_t data_size, uint64_t meta_size) {
+  auto* s = static_cast<Store*>(handle);
+  std::string key = IdKey(id);
+  uint64_t total = data_size + meta_size;
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->objects.count(key)) return -1;
+  if (total > s->capacity) return -2;
+  if (!EvictFor(s, total)) return -2;
+  ObjectEntry e;
+  e.path = slab_path;
+  e.data_size = data_size;
+  e.meta_size = meta_size;
+  e.slab_backed = true;
+  s->used += total;
+  s->objects.emplace(key, std::move(e));
+  return 0;
+}
+
+// graftshm: seal a staged entry and pin it as a primary copy in one
+// step (mirrors store_ingest_object's pinned admission: the agent's
+// ledger pin must not race eviction). *total_out gets data+meta for
+// the journal record. 0 ok, -1 missing or already sealed.
+int store_seal_pin(void* handle, const char* id, uint64_t* total_out) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->objects.find(IdKey(id));
+  if (it == s->objects.end()) return -1;
+  ObjectEntry& e = it->second;
+  if (e.sealed) return -1;
+  e.sealed = true;
+  e.pinned = true;
+  LruRemove(s, &e);
+  if (total_out != nullptr) *total_out = e.data_size + e.meta_size;
+  return 0;
+}
+
+// graftshm: register/unregister (fn=null) the arena recycler for
+// slab-backed erases.
+void store_set_slab_recycler(void* handle,
+                             void (*fn)(void*, const char*, uint64_t),
+                             void* ctx) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  s->slab_recycler = fn;
+  s->slab_recycler_ctx = ctx;
 }
 
 // Borrowed pointer to the store's directory string (valid for the

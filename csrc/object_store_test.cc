@@ -18,6 +18,7 @@
 #include <vector>
 
 #include <fcntl.h>
+#include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -44,6 +45,12 @@ int store_client_request(int fd, uint8_t op, const char* oid, uint64_t a,
                          uint64_t b, const char* name, int32_t* rc_out,
                          uint64_t* ds_out, uint64_t* ms_out,
                          char* path_out, int path_cap);
+int store_client_create(int fd, const char* oid, uint64_t data_size,
+                        uint64_t meta_size, int32_t* rc_out,
+                        uint64_t* reused_out, char* path_out, int path_cap,
+                        int* slab_fd_out);
+int store_client_seal(int fd, const char* oid, int32_t* rc_out,
+                      uint64_t* ds_out, uint64_t* ms_out);
 void store_client_close(int fd);
 uint64_t store_used(void* handle);
 uint64_t store_capacity(void* handle);
@@ -330,6 +337,107 @@ void TestSidecarProtocol() {
   std::printf("  sidecar OK\n");
 }
 
+void TestShmCreateSealWire() {
+  // graftshm over the sidecar socket: CREATE passes a slab fd the
+  // client serializes into; SEAL publishes it; GET returns the SAME
+  // slab path (no rename, no copy); erase recycles the slab so the
+  // next same-size CREATE reports a warm reuse.
+  std::string dir = TempDir("shm-wire");
+  void* s = store_create(dir.c_str(), 1 << 16);
+  std::string sock = dir + ".sock";
+  int notify_fd = -1;
+  void* srv = store_server_start(s, sock.c_str(), &notify_fd);
+  assert(srv != nullptr);
+  int fd = store_client_connect(sock.c_str());
+  assert(fd >= 0);
+
+  std::string id = MakeId('m');
+  int32_t rc;
+  uint64_t reused = 99, ds, ms;
+  char spath[4096], path[4096];
+  int slab_fd = -1;
+  assert(store_client_create(fd, id.c_str(), 4096, 64, &rc, &reused,
+                             spath, sizeof spath, &slab_fd) == 0);
+  assert(rc == 0 && reused == 0 && slab_fd >= 0);
+  assert(std::strstr(spath, "shmslab-") != nullptr);
+  // Staged: visible to contains as unsealed, not gettable.
+  assert(store_contains(s, id.c_str()) == 2);
+  assert(store_get(s, id.c_str(), path, sizeof path, &ds, &ms) == -2);
+  // Serialize "in place" through the mapping.
+  void* m = ::mmap(nullptr, 4096 + 64, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   slab_fd, 0);
+  assert(m != MAP_FAILED);
+  std::memset(m, 'z', 4096 + 64);
+  std::memcpy(m, "shm-inplace!", 12);
+  ::munmap(m, 4096 + 64);
+  ::close(slab_fd);
+  // SEAL publishes; GET hands back the very same slab path.
+  assert(store_client_seal(fd, id.c_str(), &rc, &ds, &ms) == 0);
+  assert(rc == 0);
+  assert(store_client_seal(fd, id.c_str(), &rc, &ds, &ms) == 0);
+  assert(rc == -1);  // double-seal rejected
+  assert(store_client_request(fd, 2, id.c_str(), 0, 0, nullptr, &rc, &ds,
+                              &ms, path, sizeof path) == 0);
+  assert(rc == 0 && ds == 4096 && ms == 64);
+  assert(std::strcmp(path, spath) == 0);
+  char buf[12];
+  int rfd = ::open(path, O_RDONLY);
+  assert(rfd >= 0);
+  assert(::read(rfd, buf, 12) == 12);
+  ::close(rfd);
+  assert(std::memcmp(buf, "shm-inplace!", 12) == 0);
+  // The seal was journaled as an ingest (op 1) with the total size.
+  char jbuf[29 * 4];
+  int n = store_server_drain(srv, jbuf, sizeof jbuf);
+  assert(n == 29);
+  assert(jbuf[0] == 1 && std::memcmp(jbuf + 1, id.data(), 20) == 0);
+  uint64_t jsize;
+  std::memcpy(&jsize, jbuf + 21, 8);
+  assert(jsize == 4096 + 64);
+  // Release + delete: the slab goes back to the arena, so the next
+  // same-size CREATE is a warm reuse of the SAME file.
+  assert(store_client_request(fd, 3, id.c_str(), 0, 0, nullptr, &rc, &ds,
+                              &ms, path, sizeof path) == 0);
+  assert(store_client_request(fd, 4, id.c_str(), 0, 0, nullptr, &rc, &ds,
+                              &ms, path, sizeof path) == 0);
+  assert(rc == 0);
+  std::string id2 = MakeId('n');
+  assert(store_client_create(fd, id2.c_str(), 4096, 64, &rc, &reused,
+                             path, sizeof path, &slab_fd) == 0);
+  assert(rc == 0 && reused == 1 && slab_fd >= 0);
+  assert(std::strcmp(path, spath) == 0);
+  ::close(slab_fd);
+
+  // Over-capacity CREATE: clean -2, no fd follows, slab recycled.
+  std::string big = MakeId('o');
+  int big_fd = -1;
+  assert(store_client_create(fd, big.c_str(), 1 << 20, 0, &rc, &reused,
+                             path, sizeof path, &big_fd) == 0);
+  assert(rc == -2 && big_fd == -1);
+
+  // Client death between CREATE and SEAL: a second connection stages an
+  // object and dies; the sidecar reclaims it (store entry gone, delete
+  // journaled) so the slab cannot leak behind an invisible entry.
+  int fd2 = store_client_connect(sock.c_str());
+  assert(fd2 >= 0);
+  std::string dead = MakeId('d');
+  int dead_fd = -1;
+  assert(store_client_create(fd2, dead.c_str(), 2048, 0, &rc, &reused,
+                             path, sizeof path, &dead_fd) == 0);
+  assert(rc == 0 && dead_fd >= 0);
+  ::close(dead_fd);
+  store_client_close(fd2);  // dies before SEAL
+  for (int i = 0; i < 5000 && store_contains(s, dead.c_str()) != 0; i++) {
+    ::usleep(1000);
+  }
+  assert(store_contains(s, dead.c_str()) == 0);
+
+  store_client_close(fd);
+  store_server_stop(srv);
+  store_destroy(s);
+  std::printf("  shm create/seal wire OK\n");
+}
+
 int main() {
   TestCreateSealGetLifecycle();
   TestEvictionRespectsPinsAndRefs();
@@ -338,6 +446,7 @@ int main() {
   TestConcurrentIngestEvict();
   TestConcurrentCreateRelease();
   TestSidecarProtocol();
+  TestShmCreateSealWire();
   std::printf("object_store_test: ALL OK\n");
   return 0;
 }

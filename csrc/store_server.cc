@@ -40,6 +40,19 @@
 //        of 24-byte records; ds = records dropped so far, ms = recorder
 //        enabled flag). Touches no store state — observability only, so
 //        a slow scope reader never couples to the object data plane.
+//      9 CREATE (a=data_size, b=meta_size): graftshm — allocate a
+//        store-owned slab for the object, admit it STAGED (unsealed,
+//        invisible to readers and eviction), and pass the slab's fd to
+//        the client via SCM_RIGHTS immediately AFTER the reply frame
+//        (only when rc == 0). The reply's path field carries the slab
+//        path, ms carries a warm-slab-reuse flag. The client maps the
+//        fd and serializes in place — no bulk copy phase exists.
+//     10 SEAL: graftshm — publish a CREATEd object (staged -> sealed,
+//        pinned as the primary copy), journaled as an ingest so the
+//        agent's bookkeeping is op-agnostic. Reply carries the drop
+//        counters like PUT. A connection that dies between CREATE and
+//        SEAL gets its staged objects reclaimed (deleted + journaled)
+//        on disconnect — the slab returns to the arena.
 
 #include <atomic>
 #include <cstdint>
@@ -48,6 +61,7 @@
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include <fcntl.h>
@@ -57,6 +71,7 @@
 #include <unistd.h>
 
 #include "scope_core.h"
+#include "shm_core.h"
 
 extern "C" {
 // From object_store.cc (same shared library).
@@ -67,7 +82,14 @@ int store_get(void* handle, const char* id, char* out_path, int path_cap,
 int store_release(void* handle, const char* id);
 int store_delete(void* handle, const char* id);
 int store_contains(void* handle, const char* id);
+int store_adopt_staged(void* handle, const char* id, const char* slab_path,
+                       uint64_t data_size, uint64_t meta_size);
+int store_seal_pin(void* handle, const char* id, uint64_t* total_out);
+void store_set_slab_recycler(void* handle,
+                             void (*fn)(void*, const char*, uint64_t),
+                             void* ctx);
 const char* store_dir_ref(void* handle);
+uint64_t store_capacity(void* handle);
 }
 
 namespace {
@@ -75,7 +97,8 @@ namespace {
 constexpr int kIdSize = 20;
 constexpr uint8_t kOpIngest = 1, kOpGet = 2, kOpRelease = 3,
                   kOpDelete = 4, kOpContains = 5, kOpPut = 6,
-                  kOpDrop = 7, kOpScope = 8;
+                  kOpDrop = 7, kOpScope = 8, kOpCreate = 9,
+                  kOpSeal = 10;
 
 // First 8 oid bytes as a little-endian u64 — enough entropy to match a
 // native record back to the Python-side object id during stitching.
@@ -93,6 +116,7 @@ struct Event {       // journal entry: 29 bytes packed on drain
 
 struct Server {
   void* store = nullptr;
+  void* arena = nullptr;  // graftshm slab arena (owned; see stop())
   std::string dir;
   int listen_fd = -1;
   int notify_r = -1, notify_w = -1;  // pipe: journal nonempty signal
@@ -169,6 +193,10 @@ void* ConnLoop(void* argp) {
   // next PUT reply so the client can settle its in-flight drop list with
   // zero extra wakeups.
   uint64_t drops_seen = 0, drops_erased = 0;
+  // graftshm staged objects this client CREATEd but has not SEALed: if
+  // the client dies mid-put, these are reclaimed on disconnect so no
+  // slab leaks behind an invisible staged entry.
+  std::unordered_set<std::string> staged;
   for (;;) {
     uint8_t op;
     uint64_t a, b;
@@ -193,6 +221,7 @@ void* ConnLoop(void* argp) {
     int32_t rc = -1;
     uint64_t ds = 0, ms = 0;
     uint16_t plen = 0;
+    int send_fd = -1;  // slab fd to pass after the reply (CREATE only)
     path[0] = 0;
     switch (op) {
       case kOpIngest:
@@ -260,10 +289,50 @@ void* ConnLoop(void* argp) {
       }
       case kOpDelete:
         rc = store_delete(s->store, oid);
+        staged.erase(std::string(oid, kIdSize));
         // Journal even when the store never had it (-1): the Python
         // agent may hold spill state for the oid that must drop too.
         Journal(s, kOpDelete, oid, 0);
         break;
+      case kOpCreate: {
+        // graftshm: slab allocation + staged admission. -2 maps the
+        // arena's clean ENOSPC (and the store's full-after-eviction)
+        // onto the same code PUT uses, so the client's fallback logic
+        // is shared.
+        uint64_t total = a + b;
+        int reused = 0;
+        int sfd = shm_arena_acquire(s->arena, total, path, sizeof(path),
+                                    &reused);
+        if (sfd < 0) {
+          rc = sfd == -2 ? -2 : -3;
+          break;
+        }
+        rc = store_adopt_staged(s->store, oid, path, a, b);
+        if (rc != 0) {
+          ::close(sfd);
+          shm_arena_recycle(s->arena, path, total);
+          path[0] = 0;
+          break;
+        }
+        staged.insert(std::string(oid, kIdSize));
+        plen = (uint16_t)std::strlen(path);
+        ms = (uint64_t)reused;
+        send_fd = sfd;
+        break;
+      }
+      case kOpSeal: {
+        uint64_t total = 0;
+        rc = store_seal_pin(s->store, oid, &total);
+        // Journaled as an ingest: the agent's bookkeeping (primary
+        // ledger, seal waiters) is op-agnostic, exactly like PUT.
+        if (rc == 0) {
+          staged.erase(std::string(oid, kIdSize));
+          Journal(s, kOpIngest, oid, total);
+        }
+        ds = drops_seen;
+        ms = drops_erased;
+        break;
+      }
       case kOpContains:
         rc = store_contains(s->store, oid);
         // CONTAINS replies carry the drop counters too: the put plane
@@ -298,8 +367,25 @@ void* ConnLoop(void* argp) {
     if (!WriteFull(fd, &rc, 4) || !WriteFull(fd, &ds, 8) ||
         !WriteFull(fd, &ms, 8) || !WriteFull(fd, &plen, 2) ||
         (plen && !WriteFull(fd, path, plen))) {
+      if (send_fd >= 0) ::close(send_fd);
       break;
     }
+    if (send_fd >= 0) {
+      // The slab fd rides AFTER the reply frame (SCM_RIGHTS needs its
+      // own sendmsg; the client does recv-reply then recv-fd, in
+      // order, only when rc == 0). The server's copy closes either
+      // way — the client holds the only other reference.
+      int ok = shm_send_fd(fd, send_fd);
+      ::close(send_fd);
+      if (ok != 0) break;
+    }
+  }
+  // Reclaim staged graftshm objects this client never sealed: delete
+  // returns the slab to the arena, and the journal tells the agent to
+  // drop any bookkeeping it may have for the oid.
+  for (const auto& key : staged) {
+    store_delete(s->store, key.data());
+    Journal(s, kOpDelete, key.data(), 0);
   }
   // Release any pins this client still held (died mid GET..RELEASE).
   for (const auto& kv : pins) {
@@ -352,6 +438,12 @@ void* AcceptLoop(void* argp) {
   }
 }
 
+// Trampoline: the store's EraseObject hands slab-backed paths here
+// (under store.mu) and the arena free-lists them under its own mutex.
+void ArenaRecycleTramp(void* ctx, const char* path, uint64_t size) {
+  shm_arena_recycle(ctx, path, size);
+}
+
 }  // namespace
 
 extern "C" {
@@ -364,8 +456,16 @@ void* store_server_start(void* store_handle, const char* sock_path,
   auto* s = new Server();
   s->store = store_handle;
   s->dir = store_dir_ref(store_handle);
+  // graftshm arena: retain up to a quarter of store capacity in
+  // recycled slabs. Warm-slab reuse is the put-bandwidth win; the cap
+  // bounds how much tmpfs the free list can hold back from eviction.
+  s->arena = shm_arena_create(s->dir.c_str(),
+                              store_capacity(store_handle) / 4);
+  store_set_slab_recycler(store_handle, ArenaRecycleTramp, s->arena);
   int fds[2];
   if (::pipe(fds) != 0) {
+    store_set_slab_recycler(store_handle, nullptr, nullptr);
+    shm_arena_destroy(s->arena);
     delete s;
     return nullptr;
   }
@@ -383,6 +483,8 @@ void* store_server_start(void* store_handle, const char* sock_path,
     ::close(s->listen_fd);
     ::close(fds[0]);
     ::close(fds[1]);
+    store_set_slab_recycler(store_handle, nullptr, nullptr);
+    shm_arena_destroy(s->arena);
     delete s;
     return nullptr;
   }
@@ -390,6 +492,8 @@ void* store_server_start(void* store_handle, const char* sock_path,
     ::close(s->listen_fd);
     ::close(fds[0]);
     ::close(fds[1]);
+    store_set_slab_recycler(store_handle, nullptr, nullptr);
+    shm_arena_destroy(s->arena);
     delete s;
     return nullptr;
   }
@@ -443,6 +547,11 @@ void store_server_stop(void* handle) {
   ::close(s->notify_r);
   ::close(s->notify_w);
   if (s->active_conns.load(std::memory_order_acquire) == 0) {
+    // Unregister before destroying: a store op after stop() must not
+    // call into a freed arena. (The store itself outlives the server —
+    // the agent destroys it separately.)
+    store_set_slab_recycler(s->store, nullptr, nullptr);
+    shm_arena_destroy(s->arena);
     delete s;  // else: leak one Server rather than risk a UAF
   }
 }
@@ -513,6 +622,42 @@ int store_client_request(int fd, uint8_t op, const char* oid, uint64_t a,
   if (store_client_send(fd, op, oid, a, b, name) != 0) return -1;
   return store_client_recv(fd, rc_out, ds_out, ms_out, path_out,
                            path_cap);
+}
+
+// graftshm CREATE round-trip: request a staged slab for the object and
+// receive its fd. Returns 0 on transport success (*rc_out is the
+// server's status; *slab_fd_out is a valid mapped-writable fd iff
+// *rc_out == 0), -1 on IO error — including a failed fd-receive, after
+// which the connection is desynced and the caller must reconnect.
+int store_client_create(int fd, const char* oid, uint64_t data_size,
+                        uint64_t meta_size, int32_t* rc_out,
+                        uint64_t* reused_out, char* path_out, int path_cap,
+                        int* slab_fd_out) {
+  *slab_fd_out = -1;
+  if (store_client_send(fd, kOpCreate, oid, data_size, meta_size,
+                        nullptr) != 0) {
+    return -1;
+  }
+  uint64_t ds = 0, ms = 0;
+  if (store_client_recv(fd, rc_out, &ds, &ms, path_out, path_cap) != 0) {
+    return -1;
+  }
+  *reused_out = ms;
+  if (*rc_out != 0) return 0;  // no fd follows a non-zero reply
+  int sfd = shm_recv_fd(fd);
+  if (sfd < 0) return -1;
+  *slab_fd_out = sfd;
+  return 0;
+}
+
+// graftshm SEAL round-trip: publish a CREATEd object. Semantics of the
+// return mirror store_client_request; the reply's ds/ms carry the
+// connection's cumulative drop counters (like PUT).
+int store_client_seal(int fd, const char* oid, int32_t* rc_out,
+                      uint64_t* ds_out, uint64_t* ms_out) {
+  char path[8];
+  return store_client_request(fd, kOpSeal, oid, 0, 0, nullptr, rc_out,
+                              ds_out, ms_out, path, sizeof(path));
 }
 
 void store_client_close(int fd) { ::close(fd); }

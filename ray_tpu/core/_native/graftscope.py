@@ -76,7 +76,8 @@ KIND_NAMES = {
 
 # Sidecar op names (store protocol ops, store_server.cc kOp table).
 _SC_OPS = {1: "ingest", 2: "get", 3: "release", 4: "delete",
-           5: "contains", 6: "put", 7: "drop", 8: "scope"}
+           5: "contains", 6: "put", 7: "drop", 8: "scope",
+           9: "create", 10: "seal"}
 # graftrpc frame ops (graftrpc.OP_*; inlined to avoid an import cycle).
 _RPC_OP_CALL = 1
 _RPC_OP_REPLY = 2

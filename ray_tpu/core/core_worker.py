@@ -241,6 +241,13 @@ class CoreWorker:
         # the flag is off or the native library is unavailable.
         self._graftcopy_put: Optional[bool] = None
         self._o_tmpfile_ok: Optional[bool] = None  # probed per process
+        # graftshm put plane: store-owned slabs mapped over SCM_RIGHTS
+        # fds, serialized in place (csrc/shm_core.cc). None = unresolved;
+        # False when the flag is off or the native library is missing.
+        # The map cache reuses writable slab mappings by inode so a
+        # steady-state put loop skips the mmap/munmap pair entirely.
+        self._graftshm_put: Optional[bool] = None
+        self._shm_map_cache = None
         # Staging-inode recycling: one private hardlink ("scratch-*")
         # keeps the last staging file's tmpfs pages alive across the
         # store's delete, so the next put rewrites hot pages instead of
@@ -260,8 +267,8 @@ class CoreWorker:
         self._scratch_stale: set = set()
         # Put-phase breakdown counters (ns + put count), read by
         # bench_core.py so put regressions localize to a phase.
-        self._put_phase = {"serialize": 0, "copy": 0, "ingest": 0,
-                           "puts": 0}
+        self._put_phase = {"serialize": 0, "copy": 0, "inplace": 0,
+                           "ingest": 0, "puts": 0}
         # Per-peer batched store frees (flushed on the next loop tick).
         self._free_buf: Dict[tuple, list] = {}
         self._free_flush_scheduled = False
@@ -1298,6 +1305,19 @@ class CoreWorker:
             self._graftcopy_put = g
         return g
 
+    def _use_graftshm(self) -> bool:
+        """Resolve (once per process) whether the shared-memory put
+        plane is on: flag set AND the native library loads."""
+        g = self._graftshm_put
+        if g is None:
+            try:
+                from ray_tpu.core._native import graftshm
+                g = graftshm.available()
+            except Exception:
+                g = False
+            self._graftshm_put = g
+        return g
+
     def _try_fast_put(self, oid: bytes, sv) -> bool:
         meta = sv.meta()
         total = sv.total_size + len(meta)
@@ -1307,6 +1327,14 @@ class CoreWorker:
         fp = self._get_fastpath()
         if fp is None:
             return False
+        # graftshm plane: serialize straight into a store-owned slab —
+        # the two round-trips (CREATE with its SCM_RIGHTS fd, then SEAL)
+        # only pay off once the saved memcpy dominates, hence the size
+        # gate. Any failure falls through to graftcopy below.
+        if (total >= GlobalConfig.graftshm_min_bytes
+                and self._use_graftshm()
+                and self._put_shm(oid, sv, meta, fp)):
+            return True
         if self._use_graftcopy():
             # graftcopy plane: ALL sizes stay synchronous on the user
             # thread (it blocks on the put anyway, and both pwritev and
@@ -1406,6 +1434,100 @@ class CoreWorker:
                 "put.copy", w0, w1, oid, tid, par, sv.total_size))
             self._scope_spans.append(asm.put_span(
                 "put.ingest", w1, w2, oid, tid, par, sv.total_size))
+        e = self._entry(oid, create=True)
+        e.creating_task = None
+        e.contained = []
+        self._mark_ready_stored(oid, self.node_id, self.agent_addr,
+                                sv.total_size)
+        return True
+
+    def _put_shm(self, oid: bytes, sv, meta: bytes, fp) -> bool:
+        """graftshm put: CREATE hands back a store-owned slab fd over
+        SCM_RIGHTS; the payload is serialized IN PLACE through a cached
+        writable mapping of that slab (the bytes are written exactly
+        once, into the pages the store serves them from — there is no
+        staging file and no bulk-copy phase); SEAL publishes. Any
+        failure returns False and the graftcopy/loop paths take over;
+        a staged entry left by a mid-flight failure is deleted here (or
+        reclaimed by the sidecar on disconnect)."""
+        phase = self._put_phase
+        asm = self._scope_asm()
+        w0 = time.time_ns() if asm is not None else 0
+        t0 = time.perf_counter_ns()
+        total = sv.total_size + len(meta)
+        try:
+            rc, _spath, slab_fd, _reused = fp.create(
+                oid, sv.total_size, len(meta))
+        except OSError:
+            return False
+        if rc == -1:
+            # Already stored: puts are idempotent — success.
+            e = self._entry(oid, create=True)
+            e.creating_task = None
+            e.contained = []
+            self._mark_ready_stored(oid, self.node_id, self.agent_addr,
+                                    sv.total_size)
+            return True
+        if rc != 0:
+            # Full (-2: fall back to a path whose admission can spill)
+            # or io error (-3).
+            return False
+        try:
+            cache = self._shm_map_cache
+            if cache is None:
+                from ray_tpu.core._native.graftshm import SlabMapCache
+                cache = self._shm_map_cache = SlabMapCache()
+            m = cache.map_fd(slab_fd, total)
+            sv.write_into_mapped(memoryview(m)[:total], meta)
+        except (OSError, ValueError, BufferError):
+            # Mapping or in-place write failed: un-stage so the oid is
+            # not stuck invisible, then fall back.
+            try:
+                fp.delete(oid)
+            except OSError:
+                pass
+            return False
+        w1 = time.time_ns() if asm is not None else 0
+        t1 = time.perf_counter_ns()
+        phase["inplace"] += t1 - t0
+        try:
+            rc = fp.seal(oid)
+        except OSError:
+            # Seal failed mid-wire. The old connection's disconnect
+            # sweep reclaims the staged entry eventually, but the
+            # graftcopy fallback below RECONNECTS and its OP_PUT could
+            # race that sweep: hitting the still-staged entry reads as
+            # rc -1 "already stored" for an object the sweep then
+            # deletes. A best-effort delete on the (reconnected) client
+            # serializes ahead of the fallback put on the same
+            # connection, so the race cannot happen; if the reply was
+            # lost AFTER the seal committed, the delete defers behind
+            # the primary pin and the fallback's put sees a real
+            # sealed copy (idempotent success either way).
+            try:
+                fp.delete(oid)
+            except OSError:
+                pass
+            return False
+        phase["ingest"] += time.perf_counter_ns() - t1
+        if rc != 0:
+            try:
+                fp.delete(oid)
+            except OSError:
+                pass
+            return False
+        if asm is not None:
+            ctx = getattr(_trace_local, "ctx", None)
+            if ctx is None:
+                ctx = _trace_ctxvar.get()
+            tid = ctx[0].hex() if ctx else ""
+            par = ctx[1].hex() if ctx and ctx[1] else \
+                (ctx[0].hex() if ctx else "")
+            w2 = time.time_ns()
+            self._scope_spans.append(asm.put_span(
+                "put.inplace", w0, w1, oid, tid, par, sv.total_size))
+            self._scope_spans.append(asm.put_span(
+                "put.seal", w1, w2, oid, tid, par, sv.total_size))
         e = self._entry(oid, create=True)
         e.creating_task = None
         e.contained = []

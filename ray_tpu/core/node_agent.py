@@ -389,6 +389,12 @@ class NodeAgent:
                         # dropping the name is always safe — a live
                         # object's hex link is untouched, and a live
                         # worker recovers with a fresh scratch.
+                        # "shmslab-" files (graftshm arena slabs) are
+                        # STORE-owned — live objects and the warm free
+                        # list both live under those names; the sidecar
+                        # reclaims orphaned staged entries itself on
+                        # client disconnect, so the sweep must never
+                        # touch them (the `continue` below).
                         if name.startswith("scratch-"):
                             age_cap = 600
                         elif name.startswith(("ingest-", "put-")):

@@ -111,6 +111,16 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_char_p, ctypes.c_int]
     lib.store_client_close.argtypes = [ctypes.c_int]
+    # graftshm shared-memory put plane (shm_core.cc + store_server.cc).
+    lib.store_client_create.restype = ctypes.c_int
+    lib.store_client_create.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.store_client_seal.restype = ctypes.c_int
+    lib.store_client_seal.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
     # graftcopy engine (copy_core.cc).
     lib.copy_engine_create.restype = ctypes.c_void_p
     lib.copy_engine_create.argtypes = [ctypes.c_int]
@@ -310,6 +320,8 @@ class FastStoreClient:
     OP_PUT = 6
     OP_DROP = 7
     OP_SCOPE = 8
+    OP_CREATE = 9
+    OP_SEAL = 10
 
     def __init__(self, sock_path: str):
         import threading
@@ -407,6 +419,40 @@ class FastStoreClient:
         the connection's cumulative drop counters; settle them here."""
         rc, ds, ms, _ = self._req(self.OP_PUT, oid, data_size, meta_size,
                                   name.encode())
+        self._settle_drops(ds, ms)
+        return rc
+
+    def create(self, oid: bytes, data_size: int,
+               meta_size: int) -> Tuple[int, str, int, int]:
+        """graftshm CREATE: ask the sidecar for a store-owned slab and
+        receive its fd over SCM_RIGHTS -> (rc, slab_path, slab_fd,
+        reused). rc 0: slab_fd is an open writable descriptor the caller
+        maps and serializes into (caller owns it; close after mapping),
+        and `reused` is 1 when the slab's pages are warm (recycled). rc
+        -1 object exists (idempotent-put case), -2 cannot fit (fall back
+        to the graftcopy path whose admission can evict/spill), -3 io
+        error; slab_fd is -1 for every nonzero rc."""
+        with self._lock:
+            if self._fd < 0:
+                self._reconnect_locked()
+            slab_fd = ctypes.c_int(-1)
+            reused = ctypes.c_uint64()
+            ok = self._lib.store_client_create(
+                self._fd, oid, data_size, meta_size,
+                ctypes.byref(self._rc), ctypes.byref(reused),
+                self._path, 4096, ctypes.byref(slab_fd))
+            if ok != 0:
+                self._fail_locked()
+            return (self._rc.value, self._path.value.decode(),
+                    slab_fd.value, int(reused.value))
+
+    def seal(self, oid: bytes) -> int:
+        """graftshm SEAL: publish a CREATEd object (staged -> sealed,
+        pinned primary; journaled like a put so the agent's bookkeeping
+        is op-agnostic). The reply's ds/ms carry the connection's
+        cumulative drop counters, like PUT. 0 ok, -1 missing or already
+        sealed."""
+        rc, ds, ms, _ = self._req(self.OP_SEAL, oid)
         self._settle_drops(ds, ms)
         return rc
 

@@ -159,6 +159,52 @@ class SerializedValue:
             _pwritev_full(fd, iov, 0)
 
 
+    def write_into_mapped(self, mem: memoryview,
+                          meta: bytes = b"") -> Tuple[int, int]:
+        """In-place serialization for the graftshm put plane: land the
+        data section (and meta at the aligned tail) directly in a
+        store-owned slab mapping — the bytes are written once, into the
+        pages the store serves them from; no staging file or bulk-copy
+        phase exists. Large copies go through numpy uint8 views: on this
+        host class a numpy slice copy runs at the memcpy ceiling
+        (~7.7 GiB/s) where a raw memoryview slice-assign manages ~5.5,
+        and chunking keeps any single GIL-holding copy bounded (same
+        rationale as write_into). Alignment gaps are zeroed explicitly —
+        a recycled slab still holds a previous object's bytes, and gaps
+        must not leak them. Returns (data_size, meta_size)."""
+        import numpy as np
+        dst = np.frombuffer(mem, dtype=np.uint8)
+
+        def copy_at(off: int, view) -> None:
+            n = len(view)
+            if n >= 1 << 20:
+                src = np.frombuffer(view, dtype=np.uint8)
+                pos = 0
+                while pos < n:
+                    end = min(n, pos + self._COPY_CHUNK)
+                    dst[off + pos:off + end] = src[pos:end]
+                    pos = end
+            elif n:
+                mem[off:off + n] = view
+        off = 0
+        pb = self.pickle_bytes
+        copy_at(0, pb)
+        off = len(pb)
+        for b in self.buffers:
+            raw = b.raw()
+            aligned = _align(off)
+            if aligned != off:
+                mem[off:aligned] = _PAD[:aligned - off]
+            copy_at(aligned, raw)
+            off = aligned + len(raw)
+        aligned = _align(off)
+        if aligned != off:
+            mem[off:aligned] = _PAD[:aligned - off]
+        if meta:
+            copy_at(aligned, meta)
+        return aligned, len(meta)
+
+
 def write_payload(fd: int, sv: SerializedValue, meta: bytes = b"") -> None:
     """Land sv's data section (+ meta at the aligned tail) into a fresh
     fd via the fastest available path: the graftcopy scatter engine for

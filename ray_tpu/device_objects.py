@@ -169,6 +169,51 @@ def device_get(ref: DeviceRef, *, sharding: Optional[Any] = None,
         return host
 
 
+def device_ingest(ref: ObjectRef, *, sharding: Optional[Any] = None) -> Any:
+    """Host-store object -> device, WITHOUT materializing intermediate
+    host bytes.
+
+    ``get(ref)`` on the graftshm plane already yields numpy arrays that
+    are zero-copy READ-ONLY views into the store's shared mapping
+    (pickle-5 out-of-band buffers over the sealed slab). The missing leg
+    is handing those views to jax: numpy and jax both refuse
+    ``__dlpack__`` on read-only arrays, so each array leaf is wrapped in
+    a hand-rolled DLPack capsule (graftshm.DLPackExporter) and ingested
+    with ``jax.dlpack.from_dlpack`` — the device copy (or CPU-backend
+    buffer) is fed straight from the mapped pages. The view itself pins
+    the mapping until every consumer's deleter runs.
+
+    Non-array leaves pass through unchanged; arrays whose dtype or
+    layout has no DLPack mapping fall back to a plain device_put."""
+    import jax
+    import numpy as np
+
+    from ray_tpu import api
+    from ray_tpu.core._native.graftshm import DLPackExporter
+
+    value = api.get(ref)
+
+    def _leaf(x):
+        if not isinstance(x, np.ndarray):
+            return x
+        try:
+            arr = jax.dlpack.from_dlpack(DLPackExporter(x))
+        except (TypeError, ValueError, RuntimeError):
+            # Non-contiguous slice or a dtype without a DLPack mapping:
+            # the ordinary (copying) placement still works for numeric
+            # arrays; truly non-device-able leaves (object dtype) stay
+            # host-side untouched.
+            try:
+                arr = jax.device_put(np.ascontiguousarray(x))
+            except (TypeError, ValueError):
+                return x
+        if sharding is not None:
+            arr = jax.device_put(arr, sharding)
+        return arr
+
+    return jax.tree_util.tree_map(_leaf, value)
+
+
 def free_ref(ref: DeviceRef) -> None:
     """Explicitly drop the owner's HBM array now (idempotent). The
     ledger entry still follows normal refcounting."""

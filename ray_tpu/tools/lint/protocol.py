@@ -36,7 +36,12 @@ Walk semantics (tuned for zero false positives on real code):
     FastStoreClient(...)/LocalObjectStore(...)/self._get_fastpath().
   * One-level helper summaries: a function with a client param whose
     body performs client ops on its own params is treated as those ops
-    at its call sites (e.g. _fp_release_quiet == release).
+    at its call sites (e.g. _fp_release_quiet == release). A helper
+    whose flattened op sequence is not self-consistent (ops on
+    divergent branches — a fallback delete in an except handler next
+    to the success-path seal) cannot be replayed as a sequence no
+    single path executes; it poisons its oid params to UNKNOWN at call
+    sites instead, and its body is still walked branch-aware directly.
   * Loop bodies are walked with a fresh state (no cross-iteration
     pairing), and all tracked state is forgotten after the loop.
   * except-handler entry poisons state to UNKNOWN (the body may have
@@ -301,9 +306,37 @@ def _calls_in(node: ast.AST) -> List[ast.Call]:
     return calls
 
 
-def collect_helper_summaries(files: List[SourceFile]):
+# Summary pseudo-op: the helper touches this oid param but its op
+# sequence spans divergent branches, so state is unknowable afterward.
+_POISON = "__poison__"
+
+
+def _summary_consistent(proto, ops: List[Tuple[str, int]]) -> bool:
+    """True when replaying the flattened op sequence per oid param is
+    itself protocol-legal from UNKNOWN. A helper with a fallback delete
+    in an except handler flattens to e.g. create,delete,seal — a
+    sequence no single execution path takes; replaying it at call sites
+    would manufacture violations, so such helpers poison instead."""
+    state: Dict[int, Optional[str]] = {}
+    for op, idx in ops:
+        spec = proto["ops"].get(op)
+        if spec is None:
+            continue
+        frm = spec.get("from", "*")
+        st = state.get(idx)
+        if st is not None and frm != "*" and st not in frm:
+            return False
+        to = spec.get("to")
+        if to is not None:
+            state[idx] = to
+    return True
+
+
+def collect_helper_summaries(proto, files: List[SourceFile]):
     """name -> [(op, oid_param_index)] for helpers that apply client ops
-    directly to their own parameters (one level, no transitive chains)."""
+    directly to their own parameters (one level, no transitive chains).
+    Helpers whose flattened sequence is branch-divergent get a _POISON
+    entry per touched param instead of a replayable op list."""
     summaries: Dict[str, List[Tuple[str, int]]] = {}
     for sf in files:
         for node in ast.walk(sf.tree):
@@ -327,6 +360,9 @@ def collect_helper_summaries(files: List[SourceFile]):
                             and call.args[0].id in params:
                         ops.append((op, params.index(call.args[0].id)))
             if ops:
+                if not _summary_consistent(proto, ops):
+                    ops = [(_POISON, idx)
+                           for idx in sorted({i for _, i in ops})]
                 summaries[node.name] = ops
     return summaries
 
@@ -495,6 +531,14 @@ class _Walker:
                     self._apply(op, key, call.lineno, envs)
 
     def _apply(self, op_name: str, key: str, line: int, envs) -> None:
+        if op_name == _POISON:
+            # Branch-divergent helper: it did SOMETHING to this oid, but
+            # which path ran is unknowable here — forget state and pins
+            # (its own body is walked branch-aware where it is defined).
+            for env in envs:
+                if key in env:
+                    env[key] = (None, 0)
+            return
         spec = self.ops.get(op_name)
         if spec is None:
             return
@@ -540,7 +584,7 @@ class _Walker:
 
 
 def walk_call_sites(proto, files: List[SourceFile]) -> List[Finding]:
-    summaries = collect_helper_summaries(files)
+    summaries = collect_helper_summaries(proto, files)
     findings: List[Finding] = []
     seen: set = set()
     for sf in files:

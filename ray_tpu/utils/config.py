@@ -83,6 +83,10 @@ _flag("graftcopy_min_bytes", int, 16 * 1024**2, "Route puts at least this large 
 _flag("put_executor_offload_bytes", int, 4 * 1024**2, "Loop-path puts larger than this copy on the default executor instead of the event loop; the same knob caps the legacy (graftcopy-off) synchronous fast-put path.")
 _flag("graftcopy_scratch_max_bytes", int, 2 * 1024**3, "Per-worker staging-inode recycling cap: the put plane keeps one private hardlink ('scratch-<pid>') to its last staging file so a delete drops only the store's name and the next put of at most this size rewrites the same hot tmpfs pages (cold page allocation halves write bandwidth); 0 disables recycling.")
 
+# --- shared-memory object plane (graftshm) ---
+_flag("graftshm", bool, True, "Store-owned shared-memory put plane: OP_CREATE hands the worker a slab fd over SCM_RIGHTS, SerializedValue serializes in place through the mapping, OP_SEAL publishes — no staging file, no bulk copy phase. Falls back to the graftcopy path when off, the native library is unavailable, fd-passing fails, or the allocation cannot fit (ENOSPC).")
+_flag("graftshm_min_bytes", int, 1024**2, "Route puts at least this large through the shm create/seal plane; smaller payloads keep the single-round-trip OP_PUT (create+seal costs two round-trips, which dominates below ~1 MiB).")
+
 # --- scheduling ---
 _flag("scheduler_spread_threshold", float, 0.5, "Hybrid policy: pack below this utilization, then spread.")
 _flag("max_pending_lease_requests_per_class", int, 8, "Pipelined lease requests per scheduling class (aligned with worker_pool_max_idle_workers so steady-state bursts cause no worker churn).")

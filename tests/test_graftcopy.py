@@ -49,6 +49,27 @@ def _roundtrip(arr):
     return ref
 
 
+class _graftcopy_only:
+    """Pin puts onto the graftcopy staging plane for a test's duration.
+
+    Above graftshm_min_bytes the shm create/seal plane claims the put
+    before graftcopy staging runs, so tests that drive a specific
+    staging rung (O_TMPFILE, ENOSPC fallback, OP_PUT failure) must
+    switch it off — tests/test_graftshm.py owns the shm-plane corners.
+    """
+
+    def __init__(self, cw):
+        self._cw = cw
+
+    def __enter__(self):
+        self._cw._use_graftshm = lambda: False
+        return self._cw
+
+    def __exit__(self, *exc):
+        del self._cw._use_graftshm  # uncover the class method
+        return False
+
+
 # ---------------------------------------------------------------------------
 # seam units (no cluster)
 # ---------------------------------------------------------------------------
@@ -207,9 +228,10 @@ def test_enospc_falls_back_to_create_seal(cluster):
 
     cw._write_put_file = failing
     try:
-        arr = np.arange(MB // 8, dtype=np.float64)
-        ref = ray_tpu.put(arr)
-        np.testing.assert_array_equal(arr, ray_tpu.get(ref))
+        with _graftcopy_only(cw):
+            arr = np.arange(MB // 8, dtype=np.float64)
+            ref = ray_tpu.put(arr)
+            np.testing.assert_array_equal(arr, ray_tpu.get(ref))
     finally:
         cw._write_put_file = orig
     if cw._use_graftcopy():
@@ -234,9 +256,10 @@ def test_sidecar_failure_mid_put_falls_back(cluster):
 
     fp.put = dying
     try:
-        arr = np.arange(2 * MB // 8, dtype=np.float64)
-        ref = ray_tpu.put(arr)
-        np.testing.assert_array_equal(arr, ray_tpu.get(ref))
+        with _graftcopy_only(cw):
+            arr = np.arange(2 * MB // 8, dtype=np.float64)
+            ref = ray_tpu.put(arr)
+            np.testing.assert_array_equal(arr, ray_tpu.get(ref))
     finally:
         fp.put = orig_put
     assert boom, "OP_PUT was never attempted"
@@ -255,8 +278,9 @@ def test_o_tmpfile_unavailable_falls_back_to_named(cluster):
     old = cw._o_tmpfile_ok
     cw._o_tmpfile_ok = False
     try:
-        _roundtrip(np.arange(MB // 8, dtype=np.float64))
-        _roundtrip(np.arange(6 * MB // 8, dtype=np.float64))
+        with _graftcopy_only(cw):
+            _roundtrip(np.arange(MB // 8, dtype=np.float64))
+            _roundtrip(np.arange(6 * MB // 8, dtype=np.float64))
     finally:
         cw._o_tmpfile_ok = old
 

@@ -790,13 +790,16 @@ def _mutated_protocol(tmp_path, mutate):
 
 
 def test_protocol_artifact_committed_and_extensible():
-    # The artifact graftshm's OP_CREATE/OP_SEAL must extend: committed,
-    # parseable, and already carrying the wire-less create/seal entries.
+    # graftshm made create/seal LIVE wire ops (9/10): the artifact must
+    # carry their opcodes, reply discipline, and the seal-as-ingest
+    # journaling the agent's bookkeeping relies on.
     import json
     with open(protocol.DEFAULT_PROTOCOL) as f:
         proto = json.load(f)
-    assert proto["ops"]["create"]["value"] is None
-    assert proto["ops"]["seal"]["value"] is None
+    assert proto["ops"]["create"]["value"] == 9
+    assert proto["ops"]["seal"]["value"] == 10
+    assert proto["ops"]["seal"]["journal"] == "ingest"
+    assert proto["ops"]["create"]["reply"] is True
     assert proto["ops"]["drop"]["reply"] is False
     assert len(proto["ops"]) >= 10
 
@@ -922,6 +925,72 @@ def test_protocol_legal_patterns_clean(tmp_path):
             def via_helper(self, fp, oid):
                 fp.get(oid)
                 self.quiet_release(fp, oid)
+    """)
+    proto = protocol.load_protocol(protocol.DEFAULT_PROTOCOL)
+    fs = protocol.walk_call_sites(proto, [sf])
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_protocol_detects_one_sided_shm_op(tmp_path):
+    # Seeded drift: drop 'seal' from the artifact — the live C handler
+    # (kOpSeal=10) AND the Python OP_SEAL constant both become ops
+    # added on one side only, and both sides must surface.
+    art = _mutated_protocol(tmp_path, lambda pr: pr["ops"].pop("seal"))
+    fs = _proto_run(artifact=art)
+    assert any(f.rule == "protocol-drift" and "kOpSeal" in f.message
+               for f in fs), [f.render() for f in fs]
+    assert any(f.rule == "protocol-drift" and "OP_SEAL" in f.message
+               for f in fs), [f.render() for f in fs]
+
+
+def test_protocol_seal_before_create_flagged(tmp_path):
+    sf = _sf(tmp_path, """
+        class W:
+            def backwards(self, fp, oid):
+                fp.seal(oid)
+                fp.create(oid)   # create of an already-sealed object
+    """)
+    proto = protocol.load_protocol(protocol.DEFAULT_PROTOCOL)
+    fs = protocol.walk_call_sites(proto, [sf])
+    assert any(f.rule == "op-order" and "create" in f.message
+               for f in fs), [f.render() for f in fs]
+
+
+def test_protocol_shm_transition_flip_caught_on_real_tree(tmp_path):
+    # Flipping seal's from-set must make the REAL graftshm put plane
+    # (create -> in-place write -> seal in core_worker._put_shm)
+    # illegal: proves the walker actually covers those call sites.
+    art = _mutated_protocol(
+        tmp_path, lambda pr: pr["ops"]["seal"].update({"from": ["sealed"]}))
+    fs = _proto_run(artifact=art)
+    assert any(f.rule == "op-order" and "core_worker" in f.path
+               and "seal" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_protocol_divergent_helper_poisons_not_replays(tmp_path):
+    # A helper whose client ops live on divergent branches (the
+    # fallback delete in an except handler next to the success-path
+    # seal — the _put_shm shape) must NOT be replayed linearly at call
+    # sites: create,delete,seal is a sequence no single path executes.
+    # Its oid params poison to UNKNOWN instead, so the caller's
+    # fallback ladder stays clean.
+    sf = _sf(tmp_path, """
+        class W:
+            def shm_put(self, oid, fp):
+                fp.create(oid)
+                try:
+                    self.write_in_place(oid)
+                except OSError:
+                    fp.delete(oid)
+                    return False
+                fp.seal(oid)
+                return True
+
+            def outer(self, fp, oid):
+                if self.shm_put(oid, fp):
+                    return True
+                return fp.ingest(oid)  # fallback: state unknowable here
     """)
     proto = protocol.load_protocol(protocol.DEFAULT_PROTOCOL)
     fs = protocol.walk_call_sites(proto, [sf])

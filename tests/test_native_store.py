@@ -115,9 +115,10 @@ def test_native_store_sanitizers():
                              cwd=os.path.abspath(CSRC),
                              capture_output=True, text=True, timeout=600)
         assert out.returncode == 0, (target, out.stdout + out.stderr)
-        # All four native planes run sanitized: the store sidecar
+        # All five native planes run sanitized: the store sidecar
         # suite, the graftrpc reactor suite, the graftcopy engine
-        # suite, AND the graftscope ring-buffer suite (whose
+        # suite, the graftscope ring-buffer suite (whose
         # drain-while-writing storm is the whole point of running
-        # under TSAN) each print their own ALL OK.
-        assert out.stdout.count("ALL OK") >= 4, (target, out.stdout)
+        # under TSAN), AND the graftshm arena suite (concurrent
+        # acquire/recycle hammer) each print their own ALL OK.
+        assert out.stdout.count("ALL OK") >= 5, (target, out.stdout)
