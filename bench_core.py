@@ -248,30 +248,31 @@ def _scope_subset() -> None:
         ray_tpu.shutdown()
 
 
-def bench_scope_delta() -> None:
-    """Recorder-on vs recorder-off, each in a fresh process tree (the
-    recorder lives in every worker/agent/sidecar, so an env flip on a
-    live cluster would only cover the driver). Emits the on/off rates
-    and the overhead percentage per metric — the always-on posture is
-    held to <3% here."""
+def _ab_delta(env_var: str, row_prefix: str, budget_pct: float) -> None:
+    """Plane-on vs plane-off A/B, each arm a fresh process tree (both
+    planes live in every worker/agent/sidecar, so an env flip on a live
+    cluster would only cover the driver). Emits the on/off rates and
+    the overhead percentage per metric.
+
+    Three interleaved on/off pairs, best-of per arm, and the child
+    doubles its per-burst best-of reps (SCOPE_CHILD): a single A/B
+    pair on this host class swings +/-25% with scheduler noise — far
+    more than the few-percent effect being measured — and noise only
+    ever lowers a rate, so the per-arm maximum over enough samples is
+    the only estimator that converges to a sign-stable row (the
+    previous 2x2 arms produced a nonsensical -9.97% overhead)."""
     import subprocess
     rates: dict = {}
-    # Three interleaved on/off pairs, best-of per arm, and the child
-    # doubles its per-burst best-of reps (SCOPE_CHILD): a single A/B
-    # pair on this host class swings +/-25% with scheduler noise — far
-    # more than the <=3% effect being measured — and noise only ever
-    # lowers a rate, so the per-arm maximum over enough samples is the
-    # only estimator that converges to a sign-stable row (the previous
-    # 2x2 arms produced a nonsensical -9.97% overhead).
     for flag in ("1", "0", "1", "0", "1", "0"):
-        env = dict(os.environ, RAY_TPU_GRAFTSCOPE=flag)
+        env = dict(os.environ)
+        env[env_var] = flag
         cmd = [sys.executable, os.path.abspath(__file__), "--scope-subset"]
         if QUICK:
             cmd.append("--quick")
         out = subprocess.run(cmd, env=env, capture_output=True, text=True,
                              timeout=900)
         if out.returncode != 0:
-            print(json.dumps({"metric": "graftscope_overhead_pct",
+            print(json.dumps({"metric": f"{row_prefix}_overhead_pct",
                               "error": out.stderr[-500:]}), flush=True)
             return
         for line in out.stdout.splitlines():
@@ -287,13 +288,29 @@ def bench_scope_delta() -> None:
         if not on or not off:
             continue
         print(json.dumps({
-            "metric": f"graftscope_overhead_{metric}",
-            # positive = recorder costs throughput; small negatives are
-            # run-to-run noise on this host class.
+            "metric": f"{row_prefix}_overhead_{metric}",
+            # positive = the plane costs throughput; small negatives
+            # are run-to-run noise on this host class.
             "value": round((off - on) / off * 100, 2), "unit": "pct",
             "recorder_on": round(on, 2), "recorder_off": round(off, 2),
-            "budget_pct": 3.0, "host_cores": os.cpu_count(),
+            "budget_pct": budget_pct, "host_cores": os.cpu_count(),
         }), flush=True)
+
+
+def bench_scope_delta() -> None:
+    """graftscope recorder on/off — the always-on posture is held to
+    <3% here (the recorder emits on every frame send/recv/flush and
+    every sidecar request)."""
+    _ab_delta("RAY_TPU_GRAFTSCOPE", "graftscope", 3.0)
+
+
+def bench_pulse_delta() -> None:
+    """graftpulse on/off — budget 1%: the pulse plane must be nearly
+    free on the hot paths, since its per-tick work (counter block copy +
+    one 1.7KB frame per node per second) never touches a request path;
+    the histogram bump it adds to scope_emit is the only per-call
+    cost."""
+    _ab_delta("RAY_TPU_GRAFTPULSE", "graftpulse", 1.0)
 
 
 def main() -> None:
@@ -314,6 +331,7 @@ def main() -> None:
     finally:
         ray_tpu.shutdown()
     bench_scope_delta()
+    bench_pulse_delta()
     print(json.dumps({
         "metric": "_meta",
         "note": "python bench_core.py (make bench-core regenerates "

@@ -84,6 +84,17 @@ int g_free_count = 0;              // both under g_slot_lock
 SpinLock g_drain_lock;             // serializes drainers
 std::atomic<uint64_t> g_dropped{0};
 std::atomic<uint64_t> g_counters[kScopeKindCount][3];  // calls, bytes, ns
+std::atomic<uint64_t> g_hist[kScopeKindCount][kScopeHistBuckets];
+
+// Log2 bucket of a duration: 0 for anything under 2^(shift+1) ns, then
+// one bucket per doubling, clamped into the last bucket. Branch-free
+// except the two clamps; one clz on the hot path.
+inline int HistBucket(uint64_t dur_ns) {
+  uint64_t v = dur_ns >> kScopeHistShift;
+  if (v < 2) return 0;
+  int b = 63 - __builtin_clzll(v);
+  return b < kScopeHistBuckets ? b : kScopeHistBuckets - 1;
+}
 
 std::atomic<int> g_enabled{-1};  // -1 = resolve from env on first use
 
@@ -156,7 +167,11 @@ void scope_emit(uint8_t kind, uint8_t op, uint16_t chan, uint32_t size,
   if (kind >= kScopeKindCount) return;
   g_counters[kind][0].fetch_add(1, std::memory_order_relaxed);
   g_counters[kind][1].fetch_add(size, std::memory_order_relaxed);
-  if (dur_ns) g_counters[kind][2].fetch_add(dur_ns, std::memory_order_relaxed);
+  if (dur_ns) {
+    g_counters[kind][2].fetch_add(dur_ns, std::memory_order_relaxed);
+    g_hist[kind][HistBucket(dur_ns)].fetch_add(1,
+                                               std::memory_order_relaxed);
+  }
   ScopeRing* r = CurRing();
   if (r == nullptr) {
     g_dropped.fetch_add(1, std::memory_order_relaxed);
@@ -228,6 +243,17 @@ int scope_counters(uint64_t* out, int max_kinds) {
     out[i * 3 + 0] = g_counters[i][0].load(std::memory_order_relaxed);
     out[i * 3 + 1] = g_counters[i][1].load(std::memory_order_relaxed);
     out[i * 3 + 2] = g_counters[i][2].load(std::memory_order_relaxed);
+  }
+  return k;
+}
+
+int scope_histograms(uint64_t* out, int max_kinds) {
+  int k = max_kinds < kScopeKindCount ? max_kinds : kScopeKindCount;
+  for (int i = 0; i < k; i++) {
+    for (int b = 0; b < kScopeHistBuckets; b++) {
+      out[i * kScopeHistBuckets + b] =
+          g_hist[i][b].load(std::memory_order_relaxed);
+    }
   }
   return k;
 }

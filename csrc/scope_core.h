@@ -44,6 +44,46 @@ static_assert(sizeof(ScopeWireRec) == kScopeRecordSize, "record packing");
                                    kScopeScEnd = 9, kScopeScRename = 10;
 [[maybe_unused]] constexpr int kScopeKindCount = 11;  // 1 + highest kind
 
+// Per-kind log2 latency histograms (graftpulse). Bucket b counts emits
+// whose dur_ns landed in [2^(kScopeHistShift+b), 2^(kScopeHistShift+b+1)),
+// with both tails clamped: bucket 0 also absorbs anything below
+// 2^(kScopeHistShift+1) ns and the last bucket absorbs everything above.
+// Mirrored by PULSE_HIST_* in graftpulse.py (lint pass 3f).
+[[maybe_unused]] constexpr int kScopeHistBuckets = 16;
+[[maybe_unused]] constexpr int kScopeHistShift = 10;  // bucket 0 ~= 1us
+
+// graftpulse wire record: the fixed-size header of one node pulse,
+// assembled by the node agent each tick and decoded by the controller
+// (ray_tpu/core/_native/graftpulse.py). The header is followed by
+// kind_count * (3 + kScopeHistBuckets) little-endian u64s: per kind the
+// {calls, bytes, ns} counter deltas then the histogram bucket deltas.
+// Lint pass 3f keeps both sides in sync.
+#pragma pack(push, 1)
+struct PulseWireRec {  // 96 bytes on the wire, little-endian
+  uint32_t magic;         // 'PLSE' = 0x45534c50
+  uint16_t version;
+  uint16_t kind_count;    // scope kinds in the trailing payload
+  uint64_t seq;           // per-node pulse sequence number
+  uint64_t t_mono_ns;     // scope_now_ns() at assembly
+  uint64_t t_wall_ns;     // wall clock at assembly
+  uint64_t store_used;
+  uint64_t store_capacity;
+  uint32_t store_objects;
+  uint32_t shm_free_chunks;  // graftshm free-list depth
+  uint64_t shm_arena_bytes;  // graftshm arena occupancy
+  uint32_t num_workers;
+  uint32_t queue_depth;      // leases queued + running across workers
+  uint64_t rss_bytes;        // summed worker RSS
+  uint64_t scope_dropped;
+  uint64_t events_dropped;
+};
+#pragma pack(pop)
+
+constexpr int kPulseRecordSize = 96;
+static_assert(sizeof(PulseWireRec) == kPulseRecordSize, "pulse packing");
+[[maybe_unused]] constexpr uint32_t kPulseMagic = 0x45534c50;
+[[maybe_unused]] constexpr uint16_t kPulseVersion = 1;
+
 extern "C" {
 
 // Hot-path emit: appends one record to the calling thread's ring and
@@ -72,6 +112,11 @@ int scope_drain(char* buf, int cap);
 // for kind k. Writes min(max_kinds, kScopeKindCount) kinds; returns the
 // number written.
 int scope_counters(uint64_t* out, int max_kinds);
+
+// Copy the cumulative log2 latency histograms: out[16k..16k+15] = the
+// kScopeHistBuckets bucket counts for kind k. Writes
+// min(max_kinds, kScopeKindCount) kinds; returns the number written.
+int scope_histograms(uint64_t* out, int max_kinds);
 
 // Records lost to ring wraparound or slot exhaustion since process
 // start.

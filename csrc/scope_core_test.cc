@@ -195,6 +195,42 @@ int TestCounters() {
   return 0;
 }
 
+int TestHistograms() {
+  scope_set_enabled(1);
+  uint64_t h0[kScopeHistBuckets * kScopeKindCount];
+  CHECK(scope_histograms(h0, kScopeKindCount) == kScopeKindCount);
+  int k = kScopeScEnd;
+  // dur_ns == 0 must not touch the histogram (no duration recorded).
+  scope_emit((uint8_t)k, 0, 0, 8, 1, 1, 0);
+  // Sub-microsecond and ~1.5us land in bucket 0; each doubling above
+  // 2^(shift+1) moves one bucket; huge durations clamp into the last.
+  scope_emit((uint8_t)k, 0, 0, 8, 2, 1, 100);
+  scope_emit((uint8_t)k, 0, 0, 8, 3, 1, 1500);
+  scope_emit((uint8_t)k, 0, 0, 8, 4, 1, 1ull << (kScopeHistShift + 3));
+  scope_emit((uint8_t)k, 0, 0, 8, 5, 1, 1ull << 62);
+  uint64_t h1[kScopeHistBuckets * kScopeKindCount];
+  scope_histograms(h1, kScopeKindCount);
+  uint64_t* a = h0 + k * kScopeHistBuckets;
+  uint64_t* b = h1 + k * kScopeHistBuckets;
+  CHECK(b[0] - a[0] == 2);
+  CHECK(b[3] - a[3] == 1);
+  CHECK(b[kScopeHistBuckets - 1] - a[kScopeHistBuckets - 1] == 1);
+  uint64_t total = 0;
+  for (int i = 0; i < kScopeHistBuckets; i++) total += b[i] - a[i];
+  CHECK(total == 4);
+  // Disabled recorder leaves the histograms untouched too.
+  scope_set_enabled(0);
+  scope_emit((uint8_t)k, 0, 0, 8, 6, 1, 1500);
+  uint64_t h2[kScopeHistBuckets * kScopeKindCount];
+  scope_histograms(h2, kScopeKindCount);
+  for (int i = 0; i < kScopeHistBuckets * kScopeKindCount; i++) {
+    CHECK(h1[i] == h2[i]);
+  }
+  scope_set_enabled(1);
+  Drain();
+  return 0;
+}
+
 }  // namespace
 
 int main() {
@@ -204,6 +240,8 @@ int main() {
   std::printf("scope roundtrip ok\n");
   rc |= TestCounters();
   std::printf("scope counters ok\n");
+  rc |= TestHistograms();
+  std::printf("scope histograms ok\n");
   rc |= TestWraparound();
   std::printf("scope wraparound ok\n");
   rc |= TestDisable();

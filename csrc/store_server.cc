@@ -523,6 +523,16 @@ int store_server_drain(void* handle, char* buf, int cap) {
   return n;
 }
 
+// graftpulse: arena occupancy snapshot — out[0..2] = {free_bytes,
+// free_slabs, reuses}. Three arena-mutex reads; called once per pulse
+// tick from the node agent.
+void store_server_shm_stats(void* handle, uint64_t* out) {
+  auto* s = static_cast<Server*>(handle);
+  out[0] = shm_arena_free_bytes(s->arena);
+  out[1] = shm_arena_free_slabs(s->arena);
+  out[2] = shm_arena_reuses(s->arena);
+}
+
 void store_server_stop(void* handle) {
   auto* s = static_cast<Server*>(handle);
   s->stopping.store(true, std::memory_order_release);

@@ -219,11 +219,19 @@ class Autoscaler:
                  node_resources: Dict[str, float],
                  min_nodes: int = 0, max_nodes: int = 4,
                  idle_timeout_s: float = 30.0,
-                 update_period_s: float = 1.0):
+                 update_period_s: float = 1.0,
+                 p99_scale_up_ms: Optional[float] = None):
         """node_resources: the shape of one launchable node (homogeneous
         node groups; the reference's multi-node-type scheduler is the
-        extension point)."""
+        extension point).
+
+        p99_scale_up_ms: graftpulse latency signal — scale up when the
+        cluster-wide native-op p99 exceeds this many milliseconds while
+        leases are queued, even with zero pending demand (the reference
+        scales on request counts only). Default from the
+        autoscale_p99_ms config flag; 0/None disables."""
         from ray_tpu import api
+        from ray_tpu.utils.config import GlobalConfig
         self._cw = api._cw()
         self._provider = provider
         self._node_resources = dict(node_resources)
@@ -231,6 +239,9 @@ class Autoscaler:
         self._max = max_nodes
         self._idle_timeout = idle_timeout_s
         self._period = update_period_s
+        if p99_scale_up_ms is None:
+            p99_scale_up_ms = float(GlobalConfig.autoscale_p99_ms)
+        self._p99_ms = float(p99_scale_up_ms or 0.0)
         self._launched: List[Any] = []   # provider handles
         self._idle_since: Dict[bytes, float] = {}
         self._running = False
@@ -312,13 +323,29 @@ class Autoscaler:
                    + st["infeasible"])
         demands = [d for d in demands if d]
         unmet = self._bin_packs(demands, [n["available"] for n in alive])
-        if unmet and len(alive) < self._max \
+        # graftpulse latency signal: the controller folds every node's
+        # pulse histograms into a cluster p99 per native op; when the
+        # worst op's p99 blows the budget WHILE leases are queued, the
+        # cluster is saturated even if nothing is pending-infeasible —
+        # scale up on latency alone (request counts can be flat).
+        p99_budget_ms = getattr(self, "_p99_ms", 0.0)
+        p99_ms = float(st.get("native_p99_ms") or 0.0)
+        queue_depth = int(st.get("queue_depth") or 0)
+        latency_pressure = (p99_budget_ms > 0 and p99_ms > p99_budget_ms
+                            and queue_depth > 0)
+        if (unmet or latency_pressure) and len(alive) < self._max \
                 and time.time() >= self._next_launch_at:
             # One node per tick (the reference batches; conservative here).
             fits_new = self._bin_packs(unmet, [self._node_resources])
-            if len(fits_new) < len(unmet):
-                logger.info("scaling UP (+1 node) for %d unmet demands",
-                            len(unmet))
+            if len(fits_new) < len(unmet) or (latency_pressure
+                                              and not unmet):
+                if unmet:
+                    logger.info("scaling UP (+1 node) for %d unmet "
+                                "demands", len(unmet))
+                else:
+                    logger.info("scaling UP (+1 node): native p99 "
+                                "%.1fms > %.1fms with %d leases queued",
+                                p99_ms, p99_budget_ms, queue_depth)
                 self._launched.append(
                     self._provider.create_node(self._node_resources))
                 return "up"
@@ -331,7 +358,7 @@ class Autoscaler:
                 nid = n["node_id"]
                 busy = any(n["available"].get(k, 0) < v - 1e-9
                            for k, v in n["total"].items())
-                if busy or demands:
+                if busy or demands or latency_pressure:
                     self._idle_since.pop(nid, None)
                     continue
                 handle = handles_by_port.get(node_addr_ports.get(nid))

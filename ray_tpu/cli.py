@@ -5,7 +5,7 @@ Analogue of the reference's CLI (reference: python/ray/scripts/scripts.py
 
     python -m ray_tpu.cli start --head [--resources '{"CPU": 8}']
     python -m ray_tpu.cli start --address HOST:PORT      # join as a node
-    python -m ray_tpu.cli status --address HOST:PORT
+    python -m ray_tpu.cli status --address HOST:PORT [--live]
     python -m ray_tpu.cli list actors|nodes|tasks|workers --address ...
     python -m ray_tpu.cli timeline --address ... --out trace.json
     python -m ray_tpu.cli metrics --address ...
@@ -67,6 +67,8 @@ def cmd_start(args) -> int:
 def cmd_status(args) -> int:
     _connect(args.address)
     from ray_tpu import state
+    if getattr(args, "live", False):
+        return _status_live(args.interval)
     s = state.cluster_summary()
     print(f"nodes: {s['nodes_alive']}/{s['nodes_total']} alive; "
           f"actors: {s['actors']}")
@@ -75,6 +77,66 @@ def cmd_status(args) -> int:
         avail = s["resources_available"].get(k, 0)
         print(f"  {k}: {avail:g}/{total:g} available")
     return 0
+
+
+def _status_live(interval: float) -> int:
+    """Refreshing cluster view from the graftpulse telemetry plane —
+    plain ANSI clear-and-redraw, no curses (reference: `ray status`
+    is one-shot; the live view rides our pulse time series instead)."""
+    import time
+
+    from ray_tpu import state
+
+    def render(t: dict) -> str:
+        c, tot = t.get("cluster", {}), t.get("totals", {})
+        lines = [
+            f"ray_tpu cluster — {time.strftime('%H:%M:%S')}   "
+            f"(window {t.get('window_s', 0):.0f}s, "
+            f"pulse {'on' if c.get('pulse_enabled') else 'off'})",
+            f"nodes {c.get('nodes_alive', 0)} alive / "
+            f"{c.get('nodes_dead', 0)} dead · "
+            f"actors {c.get('actors_alive', 0)} alive / "
+            f"{c.get('actors_pending', 0)} pending",
+            f"objects {tot.get('store_objects', 0)} · "
+            f"store {tot.get('store_used', 0) / 2**20:.1f}/"
+            f"{tot.get('store_capacity', 0) / 2**20:.1f} MiB · "
+            f"queue {tot.get('queue_depth', 0)} · "
+            f"workers {tot.get('num_workers', 0)} · "
+            f"rss {tot.get('rss_bytes', 0) / 2**20:.0f} MiB",
+            "",
+            f"{'node':<14}{'health':<10}{'seq':>6}{'queue':>7}"
+            f"{'objects':>9}{'store MiB':>11}{'rss MiB':>9}",
+        ]
+        for nid, n in sorted(t.get("nodes", {}).items()):
+            lines.append(
+                f"{nid:<14}{n.get('health', '?'):<10}"
+                f"{n.get('seq', 0):>6}{n.get('queue_depth', 0):>7}"
+                f"{n.get('store_objects', 0):>9}"
+                f"{n.get('store_used', 0) / 2**20:>11.1f}"
+                f"{n.get('rss_bytes', 0) / 2**20:>9.0f}")
+        ops = t.get("ops", {})
+        if ops:
+            lines += ["", f"{'native op':<22}{'calls':>9}{'p50 us':>9}"
+                          f"{'p99 us':>9}{'MiB/s':>9}"]
+            for op, v in sorted(ops.items()):
+                lines.append(
+                    f"{op:<22}{v.get('calls', 0):>9}"
+                    f"{v.get('p50_ns', 0) / 1e3:>9.0f}"
+                    f"{v.get('p99_ns', 0) / 1e3:>9.0f}"
+                    f"{v.get('bytes_per_s', 0) / 2**20:>9.1f}")
+        return "\n".join(lines)
+
+    try:
+        while True:
+            try:
+                text = render(state.cluster_telemetry())
+            except Exception as e:
+                text = f"telemetry fetch failed: {e!r}"
+            sys.stdout.write("\x1b[2J\x1b[H" + text + "\n")
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_list(args) -> int:
@@ -195,8 +257,16 @@ def main(argv=None) -> int:
     sp.add_argument("--block", action="store_true")
     sp.set_defaults(fn=cmd_start)
 
-    for name, fn in (("status", cmd_status), ("metrics", cmd_metrics),
-                     ("stop", cmd_stop)):
+    sp = sub.add_parser("status")
+    sp.add_argument("--address", required=True)
+    sp.add_argument("--live", action="store_true",
+                    help="refreshing view over the graftpulse telemetry "
+                         "plane (Ctrl-C to exit)")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period for --live, seconds")
+    sp.set_defaults(fn=cmd_status)
+
+    for name, fn in (("metrics", cmd_metrics), ("stop", cmd_stop)):
         sp = sub.add_parser(name)
         sp.add_argument("--address", required=True)
         sp.set_defaults(fn=fn)

@@ -224,6 +224,31 @@ def counters() -> Dict[str, Tuple[int, int, int]]:
     return out
 
 
+# Log2 histogram geometry; the lint-checked mirrors of kScopeHistBuckets
+# / kScopeHistShift live in graftpulse.py (pass 3f), this module only
+# needs the array stride to read the block out.
+_HIST_BUCKETS = 16
+
+
+def histograms() -> Dict[str, Tuple[int, ...]]:
+    """Cumulative per-kind log2 latency histograms since process start:
+    {kind_name: (b0..b15)} where bucket b counts emits with dur_ns in
+    [2^(10+b), 2^(11+b)), tails clamped."""
+    lib = _get_lib()
+    if lib is None:
+        return {}
+    arr = (ctypes.c_uint64 * (_HIST_BUCKETS * KIND_COUNT))()
+    k = lib.scope_histograms(arr, KIND_COUNT)
+    out = {}
+    for kind in range(1, min(k, KIND_COUNT)):
+        name = KIND_NAMES.get(kind)
+        if name:
+            base = kind * _HIST_BUCKETS
+            out[name] = tuple(int(arr[base + b])
+                              for b in range(_HIST_BUCKETS))
+    return out
+
+
 _metrics = None
 _last_counters: Dict[str, Tuple[int, int, int]] = {}
 

@@ -130,6 +130,13 @@ class Controller:
         # sidecar spans in timeline().
         self.native_spans: "deque" = deque(maxlen=50000)
         self._oid_trace: Dict[int, tuple] = {}
+        # graftpulse: per-node pulse time series + cluster SLO aggregates
+        # (keyed by node_id.hex()[:12], same as node_metrics). The health
+        # FSM in _health_loop reads pulse cadence from here; the
+        # dashboard /api/cluster + /metrics/cluster and the autoscaler
+        # read the folded aggregates.
+        from ray_tpu.core._native.graftpulse import ClusterAggregator
+        self.pulse = ClusterAggregator(GlobalConfig.pulse_history)
         # Infeasible-demand signals, coalesced BY SHAPE (a parked lease
         # retries pick_node every ~250ms; raw per-attempt records would
         # multiply one pending task into dozens of demands and stampede
@@ -292,6 +299,94 @@ class Controller:
         """Prometheus text exposition over every node's registry."""
         from ray_tpu.utils.metrics import render_prometheus
         return render_prometheus(self.node_metrics)
+
+    async def report_pulse(self, node_id: bytes, blob: bytes) -> None:
+        """graftpulse ingest: decode one fire-and-forget pulse frame into
+        the node's ring-buffer series. Malformed frames are dropped (a
+        version-skewed agent must not kill the controller); a good pulse
+        also clears any suspect state the cadence FSM set."""
+        self.pulse.ingest(node_id.hex()[:12], blob)
+
+    async def cluster_telemetry(self, window: int = 30) -> dict:
+        """The cluster SLO view: per-op p50/p99 + throughput folded over
+        every node's recent pulses, per-node occupancy/health, plus the
+        controller's own membership and actor state. One call feeds the
+        dashboard /api/cluster, `ray_tpu status --live` and state.py."""
+        from ray_tpu.core.common import ActorState
+        snap = self.pulse.snapshot(window)
+        snap["cluster"] = {
+            "nodes_alive": sum(1 for n in self.nodes.values()
+                               if n.state == NodeState.ALIVE),
+            "nodes_dead": sum(1 for n in self.nodes.values()
+                              if n.state == NodeState.DEAD),
+            "actors_alive": sum(1 for a in self.actors.values()
+                                if a.state == ActorState.ALIVE),
+            "actors_pending": sum(1 for a in self.actors.values()
+                                  if a.state in (ActorState.PENDING,
+                                                 ActorState.RESTARTING)),
+            "pulse_enabled": bool(GlobalConfig.graftpulse),
+        }
+        # Attach address/state for nodes the pulse plane knows about and
+        # list registered nodes that never pulsed (pulse disabled or
+        # version-skewed agents) so the view is complete.
+        by_hex = {n.node_id.hex()[:12]: n for n in self.nodes.values()}
+        for hex_id, info in snap["nodes"].items():
+            n = by_hex.get(hex_id)
+            if n is not None:
+                info["addr"] = list(n.addr)
+                info["state"] = str(n.state)
+        for hex_id, n in by_hex.items():
+            if hex_id not in snap["nodes"] \
+                    and n.state == NodeState.ALIVE:
+                snap["nodes"][hex_id] = {
+                    "health": "no-pulse", "addr": list(n.addr),
+                    "state": str(n.state),
+                }
+        return snap
+
+    async def cluster_metrics_text(self) -> str:
+        """Federated Prometheus exposition for /metrics/cluster: every
+        node's pushed registry plus the pulse-derived cluster
+        aggregates (raytpu_cluster_*)."""
+        from ray_tpu.utils.metrics import render_prometheus
+        snap = self.pulse.snapshot()
+        lines = []
+
+        def gauge(name, desc, value, tags=""):
+            lines.append(f"# HELP {name} {desc}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{tags} {value}")
+
+        tot = snap["totals"]
+        gauge("raytpu_cluster_store_used_bytes",
+              "Object store bytes in use across the cluster.",
+              tot["store_used"])
+        gauge("raytpu_cluster_store_objects",
+              "Objects resident across the cluster.",
+              tot["store_objects"])
+        gauge("raytpu_cluster_queue_depth",
+              "Worker leases queued + running across the cluster.",
+              tot["queue_depth"])
+        gauge("raytpu_cluster_workers",
+              "Worker processes across the cluster.",
+              tot["num_workers"])
+        gauge("raytpu_cluster_events_dropped",
+              "Lifecycle events dropped across the cluster.",
+              tot["events_dropped"])
+        for name, o in sorted(snap["ops"].items()):
+            for metric, desc in (
+                    ("p50_ns", "p50 native-op latency (pulse window)"),
+                    ("p99_ns", "p99 native-op latency (pulse window)"),
+                    ("bytes_per_s", "native-plane throughput "
+                                    "(pulse window)")):
+                mname = f"raytpu_cluster_{metric}"
+                if not any(ln.startswith(f"# HELP {mname} ")
+                           for ln in lines):
+                    lines.append(f"# HELP {mname} {desc}")
+                    lines.append(f"# TYPE {mname} gauge")
+                lines.append(f'{mname}{{op="{name}"}} {o[metric]}')
+        return render_prometheus(self.node_metrics) + "\n" \
+            + "\n".join(lines) + "\n"
 
     async def publish_logs(self, events: list) -> None:
         for ev in events:
@@ -476,6 +571,7 @@ class Controller:
             return
         node.state = NodeState.DEAD
         self.node_metrics.pop(node_id.hex()[:12], None)  # stop reporting it
+        self.pulse.forget(node_id.hex()[:12])
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         # Actors on the node die (and maybe restart).
         for actor in list(self.actors.values()):
@@ -489,6 +585,47 @@ class Controller:
             "type": "dead", "node_id": node_id, "addr": node.addr,
             "reason": reason})
 
+    def _pulse_health_pass(self) -> List[tuple]:
+        """graftpulse cadence FSM: a node that HAS pulsed and then falls
+        silent for pulse_suspect_ticks tick periods becomes *suspect*
+        (published so dashboards/CLI surface it before the kill), and
+        after pulse_dead_ms of silence it is declared dead — proactive
+        detection that beats the heartbeat timeout (default 10s) by an
+        order of magnitude. Nodes that never pulsed (pulse disabled or
+        old agents) are left to the heartbeat path entirely.
+
+        Returns [(node_id, reason)] to mark dead — the caller awaits
+        _mark_node_dead outside this sync pass."""
+        period_s = max(0.05, GlobalConfig.pulse_period_ms / 1000)
+        suspect_after = GlobalConfig.pulse_suspect_ticks * period_s
+        dead_after = GlobalConfig.pulse_dead_ms / 1000
+        now = time.monotonic()
+        dead: List[tuple] = []
+        for node in list(self.nodes.values()):
+            if node.state != NodeState.ALIVE:
+                continue
+            s = self.pulse.series.get(node.node_id.hex()[:12])
+            if s is None or not s.pulses:
+                continue
+            silence = now - s.last_rx_mono
+            missed = int(silence / period_s)
+            s.missed_ticks = missed
+            if silence >= dead_after:
+                dead.append((node.node_id,
+                             f"pulse silence {silence:.1f}s "
+                             f"({missed} ticks missed)"))
+            elif silence >= suspect_after:
+                if s.health != "suspect":
+                    s.health = "suspect"
+                    logger.warning("node %s suspect: %d pulses missed",
+                                   node.node_id.hex()[:8], missed)
+                    self.pubsub.publish("node_events", {
+                        "type": "suspect", "node_id": node.node_id,
+                        "addr": node.addr, "missed_ticks": missed})
+            else:
+                s.health = "alive"
+        return dead
+
     async def _health_loop(self) -> None:
         period = GlobalConfig.health_check_period_ms / 1000
         timeout = GlobalConfig.health_check_timeout_ms / 1000
@@ -500,6 +637,8 @@ class Controller:
                 if node.state == NodeState.ALIVE and node.last_heartbeat < cutoff:
                     await self._mark_node_dead(node.node_id,
                                                "health check timeout")
+            for node_id, reason in self._pulse_health_pass():
+                await self._mark_node_dead(node_id, reason)
             if time.monotonic() - last_reconcile > 10.0:
                 last_reconcile = time.monotonic()
                 await self._reconcile_bundles()
@@ -612,6 +751,11 @@ class Controller:
             "infeasible": infeasible,
             "pending_actors": pending_actors,
             "pending_pg_bundles": pending_pg_bundles,
+            # graftpulse scaling signals: the slowest per-op p99 across
+            # the cluster plus the summed lease queue depth — latency-
+            # aware scale-up instead of request counting.
+            "native_p99_ms": self.pulse.worst_p99_ns() / 1e6,
+            "queue_depth": self.pulse.total_queue_depth(),
             "nodes": [{
                 "node_id": n.node_id, "state": n.state,
                 "total": n.resources_total,

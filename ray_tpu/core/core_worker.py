@@ -577,8 +577,14 @@ class CoreWorker:
             buf, self._scope_spans = self._scope_spans, []
             spans.extend(buf)
         # Worker-process counters (rpc send/flush, copy) fold into this
-        # process's metrics registry on the same tick.
+        # process's metrics registry on the same tick, and the
+        # cumulative blocks ride to the node agent so the graftpulse
+        # tick can fold client-side op deltas into the node pulse.
         graftscope.publish_counters()
+        counters = graftscope.counters()
+        if counters and getattr(self, "agent", None) is not None:
+            self._spawn(self._send_scope_blocks(
+                counters, graftscope.histograms()))
         if spans:
             # Bound the batch: a controller outage must not turn the
             # span buffer into a leak.
@@ -587,6 +593,14 @@ class CoreWorker:
     async def _send_native_spans(self, spans: list) -> None:
         try:
             await self.controller.call("report_native_spans", spans)
+        except Exception:
+            pass  # observability is best-effort
+
+    async def _send_scope_blocks(self, counters: dict,
+                                 hists: dict) -> None:
+        try:
+            await self.agent.call("report_scope",
+                                  self.worker_id.binary(), counters, hists)
         except Exception:
             pass  # observability is best-effort
 

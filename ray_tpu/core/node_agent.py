@@ -207,6 +207,9 @@ class NodeAgent:
 
         self.workers: Dict[bytes, WorkerProc] = {}       # by worker_id
         self.idle_workers: List[WorkerProc] = []
+        # graftpulse: latest cumulative scope blocks forwarded by each
+        # worker (rpc/copy/shm kinds only tick in worker processes)
+        self._worker_scope: Dict[bytes, Tuple[dict, dict]] = {}
         self._pending_registration: Dict[int, WorkerProc] = {}  # by pid
         # lease_id -> (worker, resources, pg_id|None, bundle_index)
         self.leases: Dict[bytes, tuple] = {}
@@ -270,6 +273,9 @@ class NodeAgent:
         spawn(self._heartbeat_loop())
         spawn(self._reap_loop())
         spawn(self._metrics_loop())
+        from ray_tpu.core._native import graftpulse
+        if graftpulse.enabled():
+            spawn(self._pulse_loop())
         if GlobalConfig.memory_monitor_refresh_ms > 0:
             spawn(self._memory_monitor_loop())
         if GlobalConfig.worker_prestart > 0:
@@ -429,6 +435,60 @@ class NodeAgent:
                     M.snapshot_all())
             except Exception as e:
                 logger.debug("metrics push failed: %r", e)
+
+    async def _pulse_loop(self) -> None:
+        """graftpulse tick: assemble one fixed-schema pulse (scope
+        counter + histogram deltas, graftshm arena occupancy, store
+        object counts, lease queue depth, summed worker RSS) and ship it
+        to the controller fire-and-forget. A missed reply costs nothing
+        — the controller's health FSM reads pulse *cadence*, and the
+        next tick carries fresh deltas regardless."""
+        from ray_tpu.core._native import graftpulse
+        from ray_tpu.utils import events as E
+        asm = graftpulse.PulseAssembler()
+        period = max(0.05, GlobalConfig.pulse_period_ms / 1000)
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            try:
+                free_b = free_slabs = 0
+                if self._fastpath is not None:
+                    # Executor hop: shm_stats crosses into the C sidecar
+                    # handle; keep the agent loop free of native calls.
+                    free_b, free_slabs, _ = await \
+                        asyncio.get_running_loop().run_in_executor(
+                            None, self._fastpath.shm_stats)
+                rss = sum(graftpulse.proc_rss_bytes(w.proc.pid)
+                          for w in self.workers.values()
+                          if w.proc.poll() is None)
+                # Drop scope blocks of departed workers so the
+                # assembler forgets their per-source cumulatives.
+                self._worker_scope = {
+                    wid: blocks
+                    for wid, blocks in self._worker_scope.items()
+                    if wid in self.workers}
+                extra = {"w:" + wid.hex()[:12]: blocks
+                         for wid, blocks in self._worker_scope.items()}
+                pulse = asm.assemble(
+                    extra_sources=extra,
+                    store_used=self.store.used(),
+                    store_capacity=self.store.capacity(),
+                    store_objects=self.store.num_objects(),
+                    shm_free_chunks=free_slabs,
+                    shm_arena_bytes=free_b,
+                    num_workers=len(self.workers),
+                    queue_depth=len(self.leases)
+                    + len(self._lease_waiters),
+                    rss_bytes=rss,
+                    events_dropped=E.dropped_total())
+                await asyncio.wait_for(
+                    self.controller.call(
+                        "report_pulse", self.node_id.binary(),
+                        graftpulse.encode(pulse)),
+                    timeout=max(period, 1.0))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.debug("pulse push failed: %r", e)
 
     # ------------------------------------------------------------------
     # memory monitor + OOM killing (reference: src/ray/common/
@@ -872,6 +932,17 @@ class NodeAgent:
     async def sock_path(self) -> str:
         """Unix-socket endpoint for same-host clients ('' if disabled)."""
         return getattr(self, "_sock_path", "")
+
+    async def report_scope(self, worker_id: bytes, counters: dict,
+                           hists: dict) -> None:
+        """graftpulse: a worker's cumulative scope counter/histogram
+        blocks, forwarded on its flush tick. The pulse loop folds these
+        into the node pulse — the hot client-side kinds (rpc_send/flush,
+        copy scatter, shm in-place writes) never tick in the agent
+        process, so without them the pulse would carry sidecar service
+        ops and nothing else."""
+        if worker_id in self.workers:
+            self._worker_scope[worker_id] = (counters, hists)
 
     async def _prestart_workers(self, n: int) -> None:
         """Warm the pool at startup (reference: worker_pool.cc

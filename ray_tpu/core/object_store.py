@@ -93,6 +93,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.store_server_drain.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.store_server_shm_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
     lib.store_client_connect.restype = ctypes.c_int
     lib.store_client_connect.argtypes = [ctypes.c_char_p]
     lib.store_client_request.restype = ctypes.c_int
@@ -145,6 +147,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.scope_drain.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.scope_counters.restype = ctypes.c_int
     lib.scope_counters.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+    lib.scope_histograms.restype = ctypes.c_int
+    lib.scope_histograms.argtypes = [
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
     lib.scope_dropped.restype = ctypes.c_uint64
     lib.scope_dropped.argtypes = []
@@ -303,6 +308,14 @@ class StoreSidecar:
                             int.from_bytes(rec[21:29], "little")))
             if n < len(self._buf):
                 return out
+
+    def shm_stats(self):
+        """-> (free_bytes, free_slabs, reuses) of the graftshm arena."""
+        if not self._handle:
+            return (0, 0, 0)
+        arr = (ctypes.c_uint64 * 3)()
+        self._lib.store_server_shm_stats(self._handle, arr)
+        return (int(arr[0]), int(arr[1]), int(arr[2]))
 
     def stop(self) -> None:
         if self._handle:

@@ -9,7 +9,11 @@ React client is out of scope). Endpoints:
     GET /api/nodes|actors|tasks|workers|jobs
     GET /api/timeline    -> Chrome-trace JSON incl. graftscope native spans
     GET /api/native      -> native hot-path latency rollup (graftscope)
+    GET /api/cluster     -> graftpulse SLO view (per-op p50/p99, per-node
+                            occupancy + pulse health, resident totals)
     GET /metrics         -> Prometheus text exposition
+    GET /metrics/cluster -> federated exposition + raytpu_cluster_*
+                            pulse aggregates
 
 Run via `python -m ray_tpu.cli dashboard --address H:P [--port 8265]`
 or `start_dashboard(...)` in a driver.
@@ -46,12 +50,16 @@ _PAGE = """<!doctype html>
 <h3>Workers</h3><table id="workers"></table>
 <h3>Task summary</h3><table id="tasks"></table>
 <h3>Native hot paths (graftscope)</h3><table id="native"></table>
+<h3>Cluster telemetry (graftpulse)</h3>
+<div id="pulse" class="muted"></div><table id="cluster"></table>
 <h3>Jobs</h3><table id="jobs"></table>
 <p class="muted">raw: <a href="/api/summary">summary</a> ·
 <a href="/api/nodes">nodes</a> · <a href="/api/actors">actors</a> ·
 <a href="/api/tasks">tasks</a> · <a href="/api/workers">workers</a> ·
 <a href="/api/jobs">jobs</a> · <a href="/api/native">native</a> ·
-<a href="/api/timeline">timeline</a> · <a href="/metrics">metrics</a></p>
+<a href="/api/cluster">cluster</a> ·
+<a href="/api/timeline">timeline</a> · <a href="/metrics">metrics</a> ·
+<a href="/metrics/cluster">metrics/cluster</a></p>
 <script>
 const fmt = v => typeof v === "number" && !Number.isInteger(v)
     ? v.toFixed(2) : v;
@@ -76,9 +84,10 @@ function usage(total, avail) {
 }
 async function tick() {
   try {
-    const [s, nodes, actors, tasks, workers, jobs, native] =
+    const [s, nodes, actors, tasks, workers, jobs, native, cluster] =
       await Promise.all(
-      ["summary","nodes","actors","tasks","workers","jobs","native"].map(
+      ["summary","nodes","actors","tasks","workers","jobs","native",
+       "cluster"].map(
         p => fetch("/api/" + p).then(r => r.json())));
     document.getElementById("summary").textContent =
       `nodes ${s.nodes_alive}/${s.nodes_total} · actors ${s.actors} · ` +
@@ -105,6 +114,17 @@ async function tick() {
       (w, c) => fmt(w[c]));
     table("native", native, ["name","count","mean_us","max_us"],
       (r, c) => fmt(r[c]));
+    const tot = cluster.totals || {};
+    document.getElementById("pulse").textContent =
+      `objects ${tot.store_objects ?? 0} · queue ${
+       tot.queue_depth ?? 0} · workers ${tot.num_workers ?? 0} · ` +
+      `store ${fmt((tot.store_used ?? 0) / 1048576)}MiB · ` +
+      `window ${fmt(cluster.window_s ?? 0)}s`;
+    table("cluster",
+      Object.entries(cluster.ops || {}).map(([op, v]) => ({op, ...v})),
+      ["op","calls","p50_ns","p99_ns","calls_per_s","bytes_per_s"],
+      (r, c) => c === "p50_ns" || c === "p99_ns"
+        ? fmt(r[c] / 1000) + "us" : fmt(r[c]));
     table("jobs", jobs, ["job_id","status","entrypoint"],
       (j, c) => j[c] ?? "");
     document.getElementById("ts").textContent =
@@ -139,6 +159,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, state.metrics_text().encode(),
                            "text/plain; version=0.0.4")
                 return
+            if self.path == "/metrics/cluster":
+                self._send(200, state.cluster_metrics_text().encode(),
+                           "text/plain; version=0.0.4")
+                return
             routes = {
                 "/api/summary": state.cluster_summary,
                 "/api/nodes": state.list_nodes,
@@ -147,6 +171,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/api/workers": state.list_workers,
                 "/api/timeline": state.timeline,
                 "/api/native": state.native_latency,
+                "/api/cluster": state.cluster_telemetry,
             }
             if self.path == "/api/jobs":
                 from ray_tpu import job_submission

@@ -187,6 +187,14 @@ class ServeController:
             try:
                 with self._lock:
                     states = list(self._deployments.values())
+                # One graftpulse fetch per pass, shared by every
+                # deployment — and only when some deployment actually
+                # scales on native latency.
+                p99_ms = 0.0
+                if any((st.config.get("autoscaling_config") or {})
+                       .get("target_native_p99_ms")
+                       for st in states):
+                    p99_ms = self._native_p99_ms()
                 for st in states:
                     # Probe replicas WITHOUT the lock (blocking RPCs must
                     # not starve deploy/routing_table), then mutate under
@@ -199,7 +207,7 @@ class ServeController:
                         if self._deployments.get(st.name) is not st:
                             continue
                         self._health_pass(st, health)
-                        self._autoscale_pass(st, loads)
+                        self._autoscale_pass(st, loads, p99_ms)
             except Exception:
                 pass
 
@@ -268,8 +276,20 @@ class ServeController:
             return sum(1 for r in st.replicas
                        if st.healthy.get(r.actor_id.binary()))
 
+    def _native_p99_ms(self) -> float:
+        """Cluster-wide native-op p99 from the graftpulse aggregates
+        (0.0 when the pulse plane is unavailable)."""
+        try:
+            from ray_tpu.core.ref import get_core_worker
+            cw = get_core_worker()
+            st = cw._run(cw.controller.call("autoscaler_state")).result(5)
+            return float(st.get("native_p99_ms") or 0.0)
+        except Exception:
+            return 0.0
+
     def _autoscale_pass(self, st: _DeploymentState,
-                        load_map: Dict[bytes, Any]) -> None:
+                        load_map: Dict[bytes, Any],
+                        native_p99_ms: float = 0.0) -> None:
         cfg = st.config
         auto = cfg.get("autoscaling_config")
         if not auto or not st.replicas:
@@ -280,13 +300,23 @@ class ServeController:
             return
         avg = sum(loads) / max(1, len(loads))
         target_ongoing = auto.get("target_ongoing_requests", 2.0)
+        # graftpulse latency signal: with target_native_p99_ms set, a
+        # cluster-wide native-op p99 above the budget counts as upscale
+        # pressure even while per-replica queue lengths (request counts)
+        # look fine — replicas waiting on a saturated native plane queue
+        # invisibly (reference scales on ongoing requests only;
+        # ROADMAP 4c wants the native latency table as the signal).
+        p99_budget = float(auto.get("target_native_p99_ms") or 0.0)
+        latency_pressure = (p99_budget > 0
+                            and native_p99_ms > p99_budget
+                            and avg > 0)
         n = len(st.replicas)
         since_scale = time.time() - st.last_scale
         want = n
         # Upscale reacts fast; downscale waits much longer so a brief load
         # dip doesn't drop replicas (reference: upscale_delay_s=30 vs
         # downscale_delay_s=600 defaults, autoscaling_policy.py).
-        if avg > target_ongoing:
+        if avg > target_ongoing or latency_pressure:
             if since_scale < auto.get("upscale_delay_s", 3.0):
                 return
             want = min(auto.get("max_replicas", 4), n + 1)

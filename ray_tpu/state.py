@@ -82,6 +82,22 @@ def metrics_text() -> str:
     return _ctl("metrics_text")
 
 
+def cluster_telemetry(window: int = 30) -> dict:
+    """The graftpulse cluster SLO view: per-op p50/p99 + throughput
+    folded over every node's recent pulses, per-node occupancy and
+    pulse health (alive/suspect/no-pulse), resident totals, and the
+    controller's membership/actor counts. `window` bounds how many
+    recent pulses per node feed the aggregates."""
+    return _ctl("cluster_telemetry", window)
+
+
+def cluster_metrics_text() -> str:
+    """Federated Prometheus exposition: every node's registry plus the
+    pulse-derived raytpu_cluster_* aggregates (served at
+    /metrics/cluster on the dashboard)."""
+    return _ctl("cluster_metrics_text")
+
+
 def native_latency() -> List[dict]:
     """Hot-path latency rollup over the graftscope native spans the
     controller retains: per span name (rpc.wire, sidecar.put, ...),

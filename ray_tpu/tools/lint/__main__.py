@@ -66,6 +66,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--scope-cc", default=None,
                     help="C side of the graftscope record schema "
                          "(default: csrc/scope_core.h)")
+    ap.add_argument("--pulse-py", default=None,
+                    help="Python side of the graftpulse record schema "
+                         "(default: ray_tpu/core/_native/graftpulse.py)")
+    ap.add_argument("--pulse-cc", default=None,
+                    help="C side of the graftpulse record schema "
+                         "(default: csrc/scope_core.h)")
     ap.add_argument("--rpc-root", default=None,
                     help="root scanned for RPC call sites/handlers "
                          "(default: ray_tpu/); 'none' disables")
@@ -170,6 +176,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings.append(Finding(
                 "<wire>", 1, wire_schema.RULE, "error",
                 f"graftscope schema sources missing: {s_py} / {s_cc}"))
+        # Pass 3f: graftpulse telemetry record schema.
+        p_py = args.pulse_py or os.path.join(
+            root, "ray_tpu", "core", "_native", "graftpulse.py")
+        p_cc = args.pulse_cc or os.path.join(root, "csrc", "scope_core.h")
+        if os.path.exists(p_py) and os.path.exists(p_cc):
+            findings += wire_schema.run_pulse(
+                p_py, p_cc,
+                os.path.relpath(p_py, root).replace(os.sep, "/"),
+                os.path.relpath(p_cc, root).replace(os.sep, "/"))
+        elif args.pulse_py or args.pulse_cc or not explicit_paths:
+            findings.append(Finding(
+                "<wire>", 1, wire_schema.RULE, "error",
+                f"graftpulse schema sources missing: {p_py} / {p_cc}"))
         # Pass 3d: ctypes binding signatures vs the C exports of every
         # translation unit in the shared library.
         ct_py = args.store_py or os.path.join(

@@ -951,8 +951,8 @@ def parse_scope_c(path: str) -> Tuple[ScopeCSchema, List[str]]:
         text = f.read()
 
     for m in re.finditer(r"kScope([A-Za-z0-9_]+)\s*=\s*(\d+)", text):
-        if m.group(1) == "RecordSize":
-            continue  # layout constant, not a kind
+        if m.group(1) in ("RecordSize", "HistBuckets", "HistShift"):
+            continue  # layout constants, not kinds
         schema.kinds[m.group(1)] = int(m.group(2))
     if not schema.kinds:
         errors.append("no kScope* kind constants found")
@@ -1045,4 +1045,195 @@ def run_scope(py_path: str, cc_path: str, py_rel: str, cc_rel: str
         err(py_rel, f"scope record size drift: SCOPE_RECORD_SIZE="
                     f"{py.record_size} vs kScopeRecordSize="
                     f"{cc.record_size}")
+    return findings
+
+
+# ==========================================================================
+# Pass 3f — graftpulse telemetry record drift.
+#
+# The 96-byte pulse header is hand-duplicated: the decoder layout lives
+# in `ray_tpu/core/_native/graftpulse.py` (PULSE_RECORD_FIELDS,
+# PULSE_RECORD struct format, PULSE_RECORD_SIZE, PULSE_MAGIC,
+# PULSE_VERSION, PULSE_HIST_BUCKETS/SHIFT) and again in
+# `csrc/scope_core.h` (packed struct PulseWireRec, kPulseRecordSize,
+# kPulseMagic, kPulseVersion, kScopeHistBuckets/Shift). A one-sided edit
+# skews every controller aggregate — pulses still decode, into garbage
+# occupancy numbers and shifted histogram buckets — so re-derive both
+# sides and fail on any mismatch: field name/width/order, record size,
+# magic, version, and the histogram geometry the percentile math
+# depends on.
+# ==========================================================================
+
+class PulsePySchema:
+    def __init__(self) -> None:
+        self.record_fields: List[Tuple[str, int]] = []
+        self.struct_widths: List[int] = []           # from "<IHHQ..."
+        self.record_size: Optional[int] = None
+        self.magic: Optional[int] = None
+        self.version: Optional[int] = None
+        self.hist_buckets: Optional[int] = None
+        self.hist_shift: Optional[int] = None
+
+
+def parse_pulse_py(path: str) -> Tuple[PulsePySchema, List[str]]:
+    errors: List[str] = []
+    schema = PulsePySchema()
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    scalars = {"PULSE_RECORD_SIZE": "record_size", "PULSE_MAGIC": "magic",
+               "PULSE_VERSION": "version",
+               "PULSE_HIST_BUCKETS": "hist_buckets",
+               "PULSE_HIST_SHIFT": "hist_shift"}
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1 \
+                or not isinstance(stmt.targets[0], ast.Name):
+            continue
+        name, val = stmt.targets[0].id, stmt.value
+        if name in scalars:
+            v = _const_int(val)
+            if v is None:
+                errors.append(f"cannot evaluate {name}")
+            else:
+                setattr(schema, scalars[name], v)
+        elif name == "PULSE_RECORD_FIELDS":
+            if not isinstance(val, ast.Tuple):
+                errors.append("PULSE_RECORD_FIELDS is not a tuple")
+                continue
+            for el in val.elts:
+                if (isinstance(el, ast.Tuple) and len(el.elts) == 2
+                        and isinstance(el.elts[0], ast.Constant)):
+                    w = _const_int(el.elts[1])
+                    if w is None:
+                        errors.append("PULSE_RECORD_FIELDS: bad width")
+                        continue
+                    schema.record_fields.append((el.elts[0].value, w))
+                else:
+                    errors.append("PULSE_RECORD_FIELDS: bad entry shape")
+        elif name == "PULSE_RECORD":
+            if (isinstance(val, ast.Call) and val.args
+                    and isinstance(val.args[0], ast.Constant)):
+                fmt = val.args[0].value
+                for ch in str(fmt).lstrip("<>=!@"):
+                    w = _STRUCT_CHAR_WIDTHS.get(ch)
+                    if w is None:
+                        errors.append(
+                            f"PULSE_RECORD: unknown format char {ch!r}")
+                    else:
+                        schema.struct_widths.append(w)
+            else:
+                errors.append("PULSE_RECORD is not struct.Struct(<literal>)")
+    if not schema.record_fields:
+        errors.append("PULSE_RECORD_FIELDS not found")
+    if not schema.struct_widths:
+        errors.append("PULSE_RECORD struct format not found")
+    return schema, errors
+
+
+class PulseCSchema:
+    def __init__(self) -> None:
+        self.record_fields: List[Tuple[str, int]] = []
+        self.record_size: Optional[int] = None
+        self.magic: Optional[int] = None
+        self.version: Optional[int] = None
+        self.hist_buckets: Optional[int] = None
+        self.hist_shift: Optional[int] = None
+
+
+def parse_pulse_c(path: str) -> Tuple[PulseCSchema, List[str]]:
+    errors: List[str] = []
+    schema = PulseCSchema()
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+
+    scalars = {"kPulseRecordSize": "record_size", "kPulseMagic": "magic",
+               "kPulseVersion": "version",
+               "kScopeHistBuckets": "hist_buckets",
+               "kScopeHistShift": "hist_shift"}
+    for cname, attr in scalars.items():
+        m = re.search(r"constexpr\s+[a-z0-9_]+\s+" + cname
+                      + r"\s*=\s*(0[xX][0-9a-fA-F]+|\d+)\s*;", text)
+        if m:
+            setattr(schema, attr, int(m.group(1), 0))
+        else:
+            errors.append(f"{cname} constexpr not found")
+
+    m = re.search(r"struct\s+PulseWireRec\s*\{(.*?)\};", text, re.S)
+    if not m:
+        errors.append("struct PulseWireRec not found")
+    else:
+        for fm in re.finditer(
+                r"^\s*([A-Za-z_][A-Za-z0-9_]*)\s+([A-Za-z_][A-Za-z0-9_]*)"
+                r"\s*;", m.group(1), re.M):
+            ctype, fname = fm.group(1), fm.group(2)
+            width = _C_TYPE_WIDTHS.get(ctype)
+            if width is None:
+                errors.append(f"struct PulseWireRec: unknown type {ctype}")
+                continue
+            schema.record_fields.append((fname, width))
+        if not schema.record_fields:
+            errors.append("struct PulseWireRec has no parsable fields")
+    return schema, errors
+
+
+def run_pulse(py_path: str, cc_path: str, py_rel: str, cc_rel: str
+              ) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def err(path: str, msg: str) -> None:
+        findings.append(Finding(path, 1, RULE, "error", msg))
+
+    py, py_errors = parse_pulse_py(py_path)
+    cc, cc_errors = parse_pulse_c(cc_path)
+    for e in py_errors:
+        err(py_rel, e)
+    for e in cc_errors:
+        err(cc_rel, e)
+    if py_errors or cc_errors:
+        return findings
+
+    # 1. Record layout: field-by-field name/width/order.
+    if len(py.record_fields) != len(cc.record_fields):
+        err(py_rel, f"pulse record drift: Python declares "
+                    f"{len(py.record_fields)} fields, C struct has "
+                    f"{len(cc.record_fields)}")
+    for (pn, pw), (cn, cw) in zip(py.record_fields, cc.record_fields):
+        if pn != cn:
+            err(py_rel, f"pulse record field order drift: Python has "
+                        f"{pn!r} where C has {cn!r}")
+        elif pw != cw:
+            err(py_rel, f"pulse record field {pn!r} width drift: Python "
+                        f"{pw} vs C {cw}")
+
+    # 2. Struct format chars vs the declared field widths.
+    declared = [w for _, w in py.record_fields]
+    if py.struct_widths != declared:
+        err(py_rel, f"PULSE_RECORD format widths {py.struct_widths} != "
+                    f"PULSE_RECORD_FIELDS widths {declared}")
+
+    # 3. Record size: both constants and both layouts must agree.
+    psum = sum(w for _, w in py.record_fields)
+    csum = sum(w for _, w in cc.record_fields)
+    if py.record_size is not None and psum != py.record_size:
+        err(py_rel, f"PULSE_RECORD_FIELDS pack to {psum} bytes but "
+                    f"PULSE_RECORD_SIZE={py.record_size}")
+    if cc.record_size is not None and csum != cc.record_size:
+        err(cc_rel, f"struct PulseWireRec packs to {csum} bytes but "
+                    f"kPulseRecordSize={cc.record_size}")
+    if py.record_size is not None and cc.record_size is not None \
+            and py.record_size != cc.record_size:
+        err(py_rel, f"pulse record size drift: PULSE_RECORD_SIZE="
+                    f"{py.record_size} vs kPulseRecordSize="
+                    f"{cc.record_size}")
+
+    # 4. Magic / version / histogram geometry.
+    for label, pv, cv, cname in (
+            ("magic", py.magic, cc.magic, "kPulseMagic"),
+            ("version", py.version, cc.version, "kPulseVersion"),
+            ("histogram bucket count", py.hist_buckets, cc.hist_buckets,
+             "kScopeHistBuckets"),
+            ("histogram shift", py.hist_shift, cc.hist_shift,
+             "kScopeHistShift")):
+        if pv is not None and cv is not None and pv != cv:
+            err(py_rel, f"pulse {label} drift: Python {pv} vs "
+                        f"C {cname}={cv}")
     return findings

@@ -134,6 +134,13 @@ _flag("event_stats_enabled", bool, True, "Record per-handler event-loop stats.")
 _flag("task_events_batch_size", int, 1000, "Task events per batch sent to controller.")
 _flag("metrics_report_period_ms", int, 5000, "Metrics push period.")
 _flag("graftscope", bool, True, "Native-plane flight recorder (graftscope): per-thread ring buffers in the graftrpc/graftcopy/sidecar hot paths, drained into metrics and the stitched timeline. RAY_TPU_GRAFTSCOPE=0 disables recording everywhere (Python seam and C planes read the same env).")
+_flag("graftpulse", bool, True, "Cluster telemetry plane (graftpulse): each node agent ships a fixed-schema pulse (scope counter deltas + log2 latency histograms + store/shm/worker stats) to the controller every tick; the controller folds them into SLO time series, health state and autoscaling signals. RAY_TPU_GRAFTPULSE=0 disables assembly and shipping.")
+_flag("pulse_period_ms", int, 1000, "graftpulse tick period: one pulse per node per tick.")
+_flag("pulse_suspect_ticks", int, 2, "Missed pulses before the controller marks a node suspect.")
+_flag("pulse_dead_ms", int, 8000, "Pulse silence before a suspect node is declared dead (actors restarted, owned objects re-resolved). Heartbeat liveness still applies independently.")
+_flag("pulse_history", int, 300, "Pulse samples retained per node in the controller ring buffer.")
+_flag("event_buffer_max", int, 4096, "Max buffered (unflushed) events in the exporter; beyond this the oldest are dropped and counted in the events_dropped gauge.")
+_flag("autoscale_p99_ms", float, 0.0, "Scale up when the cluster-wide native op p99 (from graftpulse histograms) exceeds this many milliseconds while work is queued; 0 disables the latency signal.")
 
 
 class Config:

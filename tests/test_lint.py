@@ -766,6 +766,68 @@ def test_scope_schema_detects_struct_format_mismatch(tmp_path):
     assert fs, "format/width mismatch not detected"
 
 # ---------------------------------------------------------------------------
+# pass 3f — graftpulse telemetry record drift
+# ---------------------------------------------------------------------------
+
+PULSE_PY = os.path.join(REPO, "ray_tpu", "core", "_native",
+                        "graftpulse.py")
+PULSE_CC = SCOPE_CC  # PulseWireRec lives in scope_core.h too
+
+
+def test_pulse_schema_repo_in_sync():
+    fs = wire_schema.run_pulse(PULSE_PY, PULSE_CC, "py", "cc")
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_pulse_schema_detects_field_width_drift(tmp_path):
+    cc = _mutated(tmp_path, PULSE_CC, "uint32_t store_objects;",
+                  "uint64_t store_objects;", "scope_core.h")
+    fs = wire_schema.run_pulse(PULSE_PY, cc, "py", "cc")
+    assert fs and any("store_objects" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_pulse_schema_detects_field_order_drift(tmp_path):
+    py = _mutated(tmp_path, PULSE_PY,
+                  '("store_objects", 4),\n    ("shm_free_chunks", 4),',
+                  '("shm_free_chunks", 4),\n    ("store_objects", 4),',
+                  "graftpulse.py")
+    fs = wire_schema.run_pulse(py, PULSE_CC, "py", "cc")
+    assert fs and any("order" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_pulse_schema_detects_record_size_drift(tmp_path):
+    py = _mutated(tmp_path, PULSE_PY, "PULSE_RECORD_SIZE = 96",
+                  "PULSE_RECORD_SIZE = 104", "graftpulse.py")
+    fs = wire_schema.run_pulse(py, PULSE_CC, "py", "cc")
+    assert fs and any("size" in f.message.lower() for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_pulse_schema_detects_struct_format_mismatch(tmp_path):
+    py = _mutated(tmp_path, PULSE_PY, 'struct.Struct("<IHHQQQQQIIQIIQQQ")',
+                  'struct.Struct("<IHHQQQQQQQQIIQQQ")', "graftpulse.py")
+    fs = wire_schema.run_pulse(py, PULSE_CC, "py", "cc")
+    assert fs, "format/width mismatch not detected"
+
+
+def test_pulse_schema_detects_magic_drift(tmp_path):
+    cc = _mutated(tmp_path, PULSE_CC, "kPulseMagic = 0x45534c50",
+                  "kPulseMagic = 0x45534c51", "scope_core.h")
+    fs = wire_schema.run_pulse(PULSE_PY, cc, "py", "cc")
+    assert fs and any("magic" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_pulse_schema_detects_hist_geometry_drift(tmp_path):
+    py = _mutated(tmp_path, PULSE_PY, "PULSE_HIST_SHIFT = 10",
+                  "PULSE_HIST_SHIFT = 11", "graftpulse.py")
+    fs = wire_schema.run_pulse(py, PULSE_CC, "py", "cc")
+    assert fs and any("shift" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+# ---------------------------------------------------------------------------
 # pass 4a — store-protocol state machine vs tools/lint/protocol.json
 # ---------------------------------------------------------------------------
 
