@@ -320,17 +320,19 @@ void TestSidecarProtocol() {
   assert(store_client_request(fd, 5, id.c_str(), 0, 0, nullptr,
                               &rc, &ds, &ms, path, sizeof path) == 0);
   assert(rc == 0);
-  // Journal carries the ingest (op 1, size 16) then the delete (op 4).
+  // Journal carries the ingest (op 1, size 16) then the delete (op 4);
+  // each record leads with the wire op it originated from.
   char pokebyte;
   assert(::read(notify_fd, &pokebyte, 1) >= 0 || true);
-  char buf[29 * 8];
+  char buf[30 * 8];
   int n = store_server_drain(srv, buf, sizeof buf);
-  assert(n == 29 * 2);
-  assert(buf[0] == 1 && std::memcmp(buf + 1, id.data(), 20) == 0);
+  assert(n == 30 * 2);
+  assert(buf[0] == 1 && buf[1] == 1 &&
+         std::memcmp(buf + 2, id.data(), 20) == 0);
   uint64_t jsize;
-  std::memcpy(&jsize, buf + 21, 8);
+  std::memcpy(&jsize, buf + 22, 8);
   assert(jsize == 16);
-  assert(buf[29] == 4);
+  assert(buf[30] == 4 && buf[31] == 4);
   store_client_close(fd);
   store_server_stop(srv);
   store_destroy(s);
@@ -386,13 +388,17 @@ void TestShmCreateSealWire() {
   assert(::read(rfd, buf, 12) == 12);
   ::close(rfd);
   assert(std::memcmp(buf, "shm-inplace!", 12) == 0);
-  // The seal was journaled as an ingest (op 1) with the total size.
-  char jbuf[29 * 4];
+  // CREATE journals its own record (op 9, origin 9), then the seal is
+  // journaled as an ingest (op 1) whose origin byte marks the shm plane.
+  char jbuf[30 * 4];
   int n = store_server_drain(srv, jbuf, sizeof jbuf);
-  assert(n == 29);
-  assert(jbuf[0] == 1 && std::memcmp(jbuf + 1, id.data(), 20) == 0);
+  assert(n == 30 * 2);
+  assert(jbuf[0] == 9 && jbuf[1] == 9 &&
+         std::memcmp(jbuf + 2, id.data(), 20) == 0);
+  assert(jbuf[30] == 1 && jbuf[31] == 10 &&
+         std::memcmp(jbuf + 32, id.data(), 20) == 0);
   uint64_t jsize;
-  std::memcpy(&jsize, jbuf + 21, 8);
+  std::memcpy(&jsize, jbuf + 52, 8);
   assert(jsize == 4096 + 64);
   // Release + delete: the slab goes back to the arena, so the next
   // same-size CREATE is a warm reuse of the SAME file.

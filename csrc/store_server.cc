@@ -108,8 +108,11 @@ uint64_t Oid64(const char* oid) {
   return v;
 }
 
-struct Event {       // journal entry: 29 bytes packed on drain
-  uint8_t op;        // kOpIngest | kOpDelete
+struct Event {       // journal entry: 30 bytes packed on drain
+  uint8_t op;        // kOpIngest | kOpDelete | kOpCreate
+  uint8_t origin;    // the wire op that caused it (grafttrail provenance:
+                     // distinguishes shm seal / copy put / drop / staged
+                     // reclaim behind the folded op)
   char oid[kIdSize];
   uint64_t size;
 };
@@ -150,13 +153,15 @@ bool WriteFull(int fd, const void* buf, size_t n) {
   return true;
 }
 
-void Journal(Server* s, uint8_t op, const char* oid, uint64_t size) {
+void Journal(Server* s, uint8_t op, uint8_t origin, const char* oid,
+             uint64_t size) {
   bool was_empty;
   {
     std::lock_guard<std::mutex> g(s->mu);
     was_empty = s->journal.empty();
     Event e;
     e.op = op;
+    e.origin = origin;
     std::memcpy(e.oid, oid, kIdSize);
     e.size = size;
     s->journal.push_back(e);
@@ -249,7 +254,7 @@ void* ConnLoop(void* argp) {
                                              : (uint32_t)(a + b),
                        Oid64(oid), 0, 0);
           }
-          Journal(s, kOpIngest, oid, a + b);
+          Journal(s, kOpIngest, op, oid, a + b);
         }
         if (op == kOpPut) {
           ds = drops_seen;
@@ -265,7 +270,7 @@ void* ConnLoop(void* argp) {
         // accumulate into the per-connection counters above.
         drops_seen++;
         if (store_delete(s->store, oid) == 0) drops_erased++;
-        Journal(s, kOpDelete, oid, 0);
+        Journal(s, kOpDelete, kOpDrop, oid, 0);
         if (svc_t0 != 0) {
           uint64_t t1 = scope_now_ns();
           uint64_t d = t1 - svc_t0;
@@ -292,7 +297,7 @@ void* ConnLoop(void* argp) {
         staged.erase(std::string(oid, kIdSize));
         // Journal even when the store never had it (-1): the Python
         // agent may hold spill state for the oid that must drop too.
-        Journal(s, kOpDelete, oid, 0);
+        Journal(s, kOpDelete, kOpDelete, oid, 0);
         break;
       case kOpCreate: {
         // graftshm: slab allocation + staged admission. -2 maps the
@@ -315,6 +320,10 @@ void* ConnLoop(void* argp) {
           break;
         }
         staged.insert(std::string(oid, kIdSize));
+        // grafttrail: a staged shm object now exists (unsealed); the
+        // agent's ledger bookkeeping stays seal-driven, but the trail
+        // wants creation provenance for conservation audits.
+        Journal(s, kOpCreate, kOpCreate, oid, total);
         plen = (uint16_t)std::strlen(path);
         ms = (uint64_t)reused;
         send_fd = sfd;
@@ -327,7 +336,7 @@ void* ConnLoop(void* argp) {
         // ledger, seal waiters) is op-agnostic, exactly like PUT.
         if (rc == 0) {
           staged.erase(std::string(oid, kIdSize));
-          Journal(s, kOpIngest, oid, total);
+          Journal(s, kOpIngest, kOpSeal, oid, total);
         }
         ds = drops_seen;
         ms = drops_erased;
@@ -385,7 +394,7 @@ void* ConnLoop(void* argp) {
   // drop any bookkeeping it may have for the oid.
   for (const auto& key : staged) {
     store_delete(s->store, key.data());
-    Journal(s, kOpDelete, key.data(), 0);
+    Journal(s, kOpDelete, kOpCreate, key.data(), 0);
   }
   // Release any pins this client still held (died mid GET..RELEASE).
   for (const auto& kv : pins) {
@@ -501,8 +510,9 @@ void* store_server_start(void* store_handle, const char* sock_path,
   return s;
 }
 
-// Drain journal events into buf as 29-byte records (u8 op | 20B oid |
-// u64 size). Returns bytes written. Also consumes the pipe signal.
+// Drain journal events into buf as 30-byte records (u8 op | u8 origin |
+// 20B oid | u64 size). Returns bytes written. Also consumes the pipe
+// signal.
 int store_server_drain(void* handle, char* buf, int cap) {
   auto* s = static_cast<Server*>(handle);
   char scratch[64];
@@ -512,11 +522,12 @@ int store_server_drain(void* handle, char* buf, int cap) {
   int n = 0;
   size_t taken = 0;
   for (const Event& e : s->journal) {
-    if (n + 29 > cap) break;
+    if (n + 30 > cap) break;
     buf[n] = (char)e.op;
-    std::memcpy(buf + n + 1, e.oid, kIdSize);
-    std::memcpy(buf + n + 21, &e.size, 8);
-    n += 29;
+    buf[n + 1] = (char)e.origin;
+    std::memcpy(buf + n + 2, e.oid, kIdSize);
+    std::memcpy(buf + n + 22, &e.size, 8);
+    n += 30;
     taken++;
   }
   s->journal.erase(s->journal.begin(), s->journal.begin() + taken);

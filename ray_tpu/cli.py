@@ -6,7 +6,11 @@ Analogue of the reference's CLI (reference: python/ray/scripts/scripts.py
     python -m ray_tpu.cli start --head [--resources '{"CPU": 8}']
     python -m ray_tpu.cli start --address HOST:PORT      # join as a node
     python -m ray_tpu.cli status --address HOST:PORT [--live]
-    python -m ray_tpu.cli list actors|nodes|tasks|workers --address ...
+    python -m ray_tpu.cli list actors|nodes|tasks|workers|objects ...
+    python -m ray_tpu.cli list tasks --state FAILED --node ID ...
+    python -m ray_tpu.cli summary tasks --address ...
+    python -m ray_tpu.cli get task ID --address ...
+    python -m ray_tpu.cli audit --address ...
     python -m ray_tpu.cli timeline --address ... --out trace.json
     python -m ray_tpu.cli metrics --address ...
     python -m ray_tpu.cli stop --address ...
@@ -143,10 +147,80 @@ def cmd_list(args) -> int:
     _connect(args.address)
     from ray_tpu import state
     kind = args.kind
-    rows = {"actors": state.list_actors, "nodes": state.list_nodes,
-            "tasks": state.list_tasks, "workers": state.list_workers}[kind]()
+    if kind == "tasks":
+        rows = state.list_tasks(state=args.state, node=args.node,
+                                name=args.task_name, actor=args.actor,
+                                limit=args.limit)
+    elif kind == "objects":
+        rows = state.list_objects(node=args.node, plane=args.plane,
+                                  limit=args.limit)
+    else:
+        rows = {"actors": state.list_actors, "nodes": state.list_nodes,
+                "workers": state.list_workers}[kind]()
     print(json.dumps(rows, indent=2, default=str))
     return 0
+
+
+def cmd_summary(args) -> int:
+    """Per-function task rollup from the grafttrail ledger (reference:
+    `ray summary tasks`)."""
+    _connect(args.address)
+    from ray_tpu import state
+    rows = state.summary_tasks()
+    if not rows:
+        print("no tasks recorded")
+        return 0
+    states = ["SUBMITTED", "LEASED", "RUNNING",
+              "FINISHED", "FAILED", "CANCELLED"]
+    hdr = f"{'function':<32}{'total':>7}{'attempts':>9}"
+    hdr += "".join(f"{s[:6]:>8}" for s in states)
+    print(hdr)
+    for r in rows:
+        line = f"{r['name'][:31]:<32}{r['total']:>7}{r['attempts']:>9}"
+        line += "".join(f"{r.get(s, 0):>8}" for s in states)
+        print(line)
+    return 0
+
+
+def cmd_get(args) -> int:
+    """Full trail for one task: attempt chain + root cause."""
+    _connect(args.address)
+    from ray_tpu import state
+    detail = state.get_task(args.id)
+    if detail is None:
+        print(f"no task matching {args.id!r} (need a unique id prefix)",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(detail, indent=2, default=str))
+    return 0
+
+
+def cmd_audit(args) -> int:
+    """Conservation audit over the trail ledger: exit 0 when every
+    non-terminal task is live on an alive node and every sealed object
+    is freed or resident; exit 1 with provenance otherwise."""
+    _connect(args.address)
+    from ray_tpu import state
+    report = state.audit(args.grace)
+    s = report["stats"]
+    print(f"tasks {s['tasks']} ({s.get('tasks_by_state', {})}) · "
+          f"objects {s['objects']} ({s['objects_live']} live) · "
+          f"events folded {s['events_folded']}")
+    if not report["complete"]:
+        print(f"ledger bounded: dropped {s['dropped_tasks']} tasks / "
+              f"{s['dropped_objects']} objects — audit covers what it saw")
+    for t in report["lost_tasks"]:
+        print(f"LOST task {t['task_id']} [{t['name']}] attempt "
+              f"{t['attempt']}: {t['audit_reason']}")
+    for o in report["leaked_objects"]:
+        print(f"LEAKED object {o['object_id']} ({o['size']}B, "
+              f"{o['plane']}, node {o['node']}): {o['audit_reason']}")
+    if report["ok"]:
+        print("audit OK: zero lost tasks, zero leaked objects")
+        return 0
+    print(f"audit FAILED: {len(report['lost_tasks'])} lost task(s), "
+          f"{len(report['leaked_objects'])} leaked object(s)")
+    return 1
 
 
 def cmd_timeline(args) -> int:
@@ -238,7 +312,21 @@ def cmd_job(args) -> int:
     elif args.action == "status":
         print(jobs.get_job_status(args.job_id))
     elif args.action == "logs":
-        print(jobs.get_job_logs(args.job_id), end="")
+        if args.follow:
+            import time as _time
+            seen = ""
+            while True:
+                text = jobs.get_job_logs(args.job_id)
+                if len(text) > len(seen):
+                    sys.stdout.write(text[len(seen):])
+                    sys.stdout.flush()
+                    seen = text
+                status = jobs.get_job_status(args.job_id)
+                if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+                    break
+                _time.sleep(args.interval)
+        else:
+            print(jobs.get_job_logs(args.job_id, tail=args.tail), end="")
     elif args.action == "stop":
         print(jobs.stop_job(args.job_id))
     elif args.action == "list":
@@ -273,9 +361,42 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("list")
     sp.add_argument("kind",
-                    choices=["actors", "nodes", "tasks", "workers"])
+                    choices=["actors", "nodes", "tasks", "workers",
+                             "objects"])
     sp.add_argument("--address", required=True)
+    sp.add_argument("--state", default=None,
+                    help="tasks: filter by FSM state (e.g. FAILED)")
+    sp.add_argument("--node", default=None,
+                    help="tasks/objects: filter by node id (hex12)")
+    sp.add_argument("--task-name", default=None,
+                    help="tasks: filter by function name")
+    sp.add_argument("--actor", default=None,
+                    help="tasks: filter by actor id (hex12)")
+    sp.add_argument("--plane", default=None,
+                    help="objects: filter by plane (shm/copy/fallback)")
+    sp.add_argument("--limit", type=int, default=100)
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="per-function task rollup from "
+                        "the grafttrail ledger")
+    sp.add_argument("kind", choices=["tasks"])
+    sp.add_argument("--address", required=True)
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("get", help="one task's full attempt chain + "
+                        "root-cause error")
+    sp.add_argument("kind", choices=["task"])
+    sp.add_argument("id", help="task id (or unique hex prefix)")
+    sp.add_argument("--address", required=True)
+    sp.set_defaults(fn=cmd_get)
+
+    sp = sub.add_parser("audit", help="conservation audit: zero lost "
+                        "tasks, zero leaked objects")
+    sp.add_argument("--address", required=True)
+    sp.add_argument("--grace", type=float, default=None,
+                    help="seconds a non-terminal task may sit without a "
+                         "transition before it counts as lost")
+    sp.set_defaults(fn=cmd_audit)
 
     sp = sub.add_parser("stack", help="dump worker Python stacks "
                         "(hung-worker debugger)")
@@ -305,6 +426,12 @@ def main(argv=None) -> int:
     sp.add_argument("--job-id", default="")
     sp.add_argument("--wait", action="store_true")
     sp.add_argument("--timeout", type=float, default=600.0)
+    sp.add_argument("--tail", type=int, default=None,
+                    help="logs: only the last N lines")
+    sp.add_argument("-f", "--follow", action="store_true",
+                    help="logs: poll for new output until the job ends")
+    sp.add_argument("--interval", type=float, default=1.0,
+                    help="poll period for --follow, seconds")
     sp.add_argument("entrypoint", nargs="*",
                     help="for submit: the shell command to run")
     sp.set_defaults(fn=cmd_job)

@@ -137,6 +137,14 @@ class Controller:
         # read the folded aggregates.
         from ray_tpu.core._native.graftpulse import ClusterAggregator
         self.pulse = ClusterAggregator(GlobalConfig.pulse_history)
+        # grafttrail: the indexed lifecycle ledger (per-attempt task FSM
+        # + object provenance). Agents fold their node's worker batches
+        # into report_trail_batch; the legacy task_events deque keeps
+        # being fed with DERIVED rows so timeline()/list_task_events/
+        # event export see the same stream they always did.
+        from ray_tpu.core._native.grafttrail import TrailLedger
+        self.trail = TrailLedger(GlobalConfig.trail_task_cap,
+                                 GlobalConfig.trail_object_cap)
         # Infeasible-demand signals, coalesced BY SHAPE (a parked lease
         # retries pick_node every ~250ms; raw per-attempt records would
         # multiply one pending task into dozens of demands and stampede
@@ -393,14 +401,99 @@ class Controller:
             self.pubsub.publish("log_events", ev)
 
     async def report_task_events(self, events: list) -> None:
+        """Legacy worker stream (trail emission disabled). The rows go
+        to the deque/export unchanged, and fold into the trail ledger
+        with what the legacy vocabulary knows (no LEASED/RUNNING)."""
+        legacy = {"submitted": "SUBMITTED", "finished": "FINISHED",
+                  "failed": "FAILED", "cancelled": "CANCELLED"}
+        for ev in events:
+            state = legacy.get(ev.get("event"))
+            if state is None:
+                continue
+            self.trail.fold_task((
+                ev.get("task_id", ""), int(ev.get("attempt", 0)), state,
+                float(ev.get("ts", 0.0)),
+                {"name": ev.get("name", ""), "owner": ev.get("owner", ""),
+                 "trace": ev.get("trace_id", ""),
+                 "pspan": ev.get("parent_span", ""),
+                 "parent": ev.get("parent_span", ""),
+                 "err": ev.get("error", "")}))
         self.task_events.extend(events)
         if self._event_exporter is not None:
             for ev in events:
                 self._event_exporter.emit("task_events", ev)
             self._event_exporter.flush()
 
+    async def report_trail_batch(self, node_id: bytes, task_events: list,
+                                 object_events: list) -> None:
+        """grafttrail ingest: one fire-and-forget batch per node per
+        flush tick. Folding returns legacy-shaped rows for the
+        transitions the old pipeline knew about — those keep feeding
+        the task_events deque and the event exporter so every derived
+        view (timeline, export JSONL, list_task_events) is unchanged."""
+        derived = []
+        for ev in task_events:
+            try:
+                row = self.trail.fold_task(tuple(ev))
+            except Exception:
+                continue
+            if row is not None:
+                derived.append(row)
+        for ev in object_events:
+            try:
+                self.trail.fold_object(tuple(ev))
+            except Exception:
+                continue
+        if derived:
+            self.task_events.extend(derived)
+            if self._event_exporter is not None:
+                for row in derived:
+                    self._event_exporter.emit("task_events", row)
+                self._event_exporter.flush()
+
     async def list_task_events(self, limit: int = 1000) -> list:
         return list(self.task_events)[-limit:]
+
+    # -- trail queries (the `ray_tpu list/summary/get/audit` backends) --
+    async def trail_tasks(self, state=None, node=None, name=None,
+                          actor=None, limit: int = 100) -> list:
+        return self.trail.list_tasks(state=state, node=node, name=name,
+                                     actor=actor, limit=limit)
+
+    async def trail_task(self, task_id: str):
+        return self.trail.get_task(task_id)
+
+    async def trail_summary(self) -> list:
+        return self.trail.summary()
+
+    async def trail_objects(self, node=None, plane=None, live=None,
+                            limit: int = 100) -> list:
+        return self.trail.list_objects(node=node, plane=plane, live=live,
+                                       limit=limit)
+
+    async def trail_stats(self) -> dict:
+        return self.trail.stats()
+
+    async def trail_audit(self, grace_s: Optional[float] = None) -> dict:
+        """Conservation audit: every non-terminal task live on an alive
+        node, every sealed object freed or still resident where the
+        ledger says. Resident oid sets come from the alive agents
+        (best-effort — an unreachable agent's node is skipped rather
+        than reported as a mass leak)."""
+        alive = {n.node_id.hex()[:12] for n in self.nodes.values()
+                 if n.state == NodeState.ALIVE}
+        residents: Dict[str, set] = {}
+        for node in self._alive_nodes():
+            try:
+                oids = await asyncio.wait_for(
+                    node.client.call("trail_residents"), timeout=2.0)
+                residents[node.node_id.hex()[:12]] = set(oids)
+            except Exception:
+                pass  # skip: absence of ground truth is not a leak
+        if grace_s is None:
+            grace_s = GlobalConfig.trail_audit_grace_s
+        return self.trail.audit(alive, residents=residents,
+                                grace_s=grace_s)
 
     async def report_native_spans(self, spans: list) -> None:
         """graftscope spans from worker flushers / agent metric ticks.
@@ -572,7 +665,14 @@ class Controller:
         node.state = NodeState.DEAD
         self.node_metrics.pop(node_id.hex()[:12], None)  # stop reporting it
         self.pulse.forget(node_id.hex()[:12])
-        logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        # Conservation fold: attempts open on the node fail with node-
+        # death provenance, live objects homed there are freed — the
+        # audit after a SIGKILL chaos pass must balance to zero.
+        folded = self.trail.node_dead(node_id.hex()[:12], reason)
+        logger.warning("node %s dead: %s (trail: %d attempts failed, "
+                       "%d objects freed)", node_id.hex()[:8], reason,
+                       len(folded["tasks_failed"]),
+                       len(folded["objects_freed"]))
         # Actors on the node die (and maybe restart).
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (
@@ -1199,6 +1299,11 @@ class Controller:
     async def shutdown_controller(self) -> None:
         """Terminate the controller process (cli stop's final step)."""
         import sys
+        try:
+            if self._event_exporter is not None:
+                self._event_exporter.flush()  # tail of the JSONL export
+        except Exception:
+            pass
         try:
             if self._dirty:
                 self._snapshot_state()

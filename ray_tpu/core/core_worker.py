@@ -485,20 +485,35 @@ class CoreWorker:
         return c
 
     # ------------------------------------------------------------------
-    # task events (owner-side; reference: task_event_buffer.cc)
+    # task events (owner-side; reference: task_event_buffer.cc).
+    # States walk the grafttrail per-attempt FSM (SUBMITTED -> LEASED ->
+    # RUNNING -> FINISHED|FAILED|CANCELLED); with the trail disabled the
+    # flush degrades to the legacy submitted/finished/failed stream.
     # ------------------------------------------------------------------
+    _trail_enabled = None  # cached per-process (env/config is fixed)
+
+    def _trail_on(self) -> bool:
+        on = self._trail_enabled
+        if on is None:
+            from ray_tpu.core._native import grafttrail
+            on = CoreWorker._trail_enabled = grafttrail.enabled()
+        return on
+
     def _record_task_event(self, task_id: bytes, name: str,
-                           event: str, trace_id: bytes = b"",
-                           parent_span: bytes = b"") -> None:
-        # Submission hot path (two events per task): append the raw
-        # tuple; dict shaping + hex conversion happen at flush time.
+                           state: str, trace_id: bytes = b"",
+                           parent_span: bytes = b"", *, attempt: int = 0,
+                           node: str = "", worker: str = "",
+                           err: str = "", actor: bytes = b"") -> None:
+        # Submission hot path (a few events per task): append the raw
+        # tuple; shaping + hex conversion happen at flush time.
         cap = self._task_events_cap
         if cap is None:
             cap = self._task_events_cap = \
                 GlobalConfig.task_events_batch_size
         with self._task_events_lock:
             self._task_events.append(
-                (task_id, name, event, time.time(), trace_id, parent_span))
+                (task_id, name, state, time.time(), trace_id, parent_span,
+                 attempt, node, worker, err, actor))
             full = len(self._task_events) >= cap
         if full:
             self._flush_task_events()
@@ -520,24 +535,68 @@ class CoreWorker:
             batch, self._task_events = self._task_events, []
         if not batch:
             return
+        from ray_tpu.core._native import grafttrail
         owner = self.worker_id.hex()[:8]
-        out = []
-        for task_id, name, event, ts, trace_id, parent_span in batch:
-            rec = {"task_id": task_id.hex(), "name": name, "event": event,
-                   "ts": ts, "owner": owner}
-            if trace_id:
-                # Span model: span id == task id; these two fields make
-                # the cross-process task TREE reconstructable from the
-                # event stream (reference: tracing_helper.py spans).
-                rec["trace_id"] = trace_id.hex()
-                rec["parent_span"] = parent_span.hex() \
-                    if parent_span else ""
-            out.append(rec)
-        self._spawn(self._send_task_events(out))
+        if not self._trail_on():
+            # Legacy stream, straight to the controller: the pre-trail
+            # vocabulary had no LEASED/RUNNING and reported a cancel as
+            # a plain failure.
+            legacy = {"SUBMITTED": "submitted", "FINISHED": "finished",
+                      "FAILED": "failed", "CANCELLED": "failed"}
+            out = []
+            for (task_id, name, state, ts, trace_id, parent_span,
+                 _attempt, _node, _worker, _err, _actor) in batch:
+                event = legacy.get(state)
+                if event is None:
+                    continue
+                rec = {"task_id": task_id.hex(), "name": name,
+                       "event": event, "ts": ts, "owner": owner}
+                if trace_id:
+                    # Span model: span id == task id; these two fields
+                    # make the cross-process task TREE reconstructable
+                    # from the event stream (reference:
+                    # tracing_helper.py spans).
+                    rec["trace_id"] = trace_id.hex()
+                    rec["parent_span"] = parent_span.hex() \
+                        if parent_span else ""
+                out.append(rec)
+            if out:
+                self._spawn(self._send_task_events(out))
+            return
+        events = []
+        for (task_id, name, state, ts, trace_id, parent_span,
+             attempt, node, wkr, err, actor) in batch:
+            # parent == parent_span because a span id IS a task id in
+            # the trace model — the trail gets the task tree for free.
+            pspan = parent_span.hex() if parent_span else ""
+            events.append(grafttrail.task_event(
+                task_id.hex(), attempt, state, ts,
+                name=name, owner=owner,
+                trace=trace_id.hex() if trace_id else "",
+                pspan=pspan, parent=pspan,
+                actor=actor.hex()[:12] if actor else "",
+                node=node, worker=wkr, err=err))
+        self._spawn(self._send_trail_events(events))
 
     async def _send_task_events(self, batch: list) -> None:
         try:
             await self.controller.call("report_task_events", batch)
+        except Exception:
+            pass  # observability is best-effort
+
+    async def _send_trail_events(self, events: list) -> None:
+        """Ship trail transitions one hop to the node agent, which folds
+        every hosted worker's batch into its flush tick (graftpulse's
+        transport shape). A process with no agent registration yet falls
+        back to reporting straight to the controller."""
+        try:
+            agent = getattr(self, "agent", None)
+            if agent is not None:
+                await agent.call("report_trail",
+                                 self.worker_id.binary(), events)
+            else:
+                await self.controller.call("report_trail_batch", b"",
+                                           events, [])
         except Exception:
             pass  # observability is best-effort
 
@@ -2391,7 +2450,7 @@ class CoreWorker:
         spec.trace_id, spec.parent_span = \
             self._trace_for_new_task(task_id.binary())
         self._task_arg_refs[task_id.binary()] = held
-        self._record_task_event(task_id.binary(), spec.name, "submitted",
+        self._record_task_event(task_id.binary(), spec.name, "SUBMITTED",
                                 spec.trace_id, spec.parent_span)
         if streaming:
             from ray_tpu.core.ref import ObjectRefGenerator
@@ -2414,8 +2473,13 @@ class CoreWorker:
         try:
             await self._submit_with_retries(spec)
         except BaseException as e:  # mark all returns failed
-            self._record_task_event(spec.task_id, spec.name, "failed",
-                                    spec.trace_id, spec.parent_span)
+            from ray_tpu.core.common import TaskCancelledError
+            self._record_task_event(
+                spec.task_id, spec.name,
+                "CANCELLED" if isinstance(e, TaskCancelledError)
+                else "FAILED",
+                spec.trace_id, spec.parent_span,
+                attempt=spec.retry_count, err=repr(e)[:256])
             err = e if isinstance(e, Exception) else WorkerCrashedError(repr(e))
             if spec.streaming:
                 self._fail_stream(spec.task_id, err)
@@ -2639,6 +2703,7 @@ class CoreWorker:
         q = self._class_queues[key]
         worker_addr = tuple(lease["worker_addr"])
         lease_node = lease.get("spilled_to", self.agent_addr)
+        node_hex = (lease.get("node_id") or b"").hex()[:12]
         client = self._client_for_worker(worker_addr)
         depth = max(1, GlobalConfig.worker_lease_pipeline_depth)
         inflight: set = set()
@@ -2683,6 +2748,11 @@ class CoreWorker:
                         batch.append((spec, fut))
                     if not batch:
                         continue
+                    if self._trail_on():
+                        for bspec, _bfut in batch:
+                            self._record_task_event(
+                                bspec.task_id, bspec.name, "LEASED",
+                                attempt=bspec.retry_count, node=node_hex)
                     if len(batch) == 1:
                         inflight.add(asyncio.ensure_future(
                             self._push_one(client, *batch[0], key=key)))
@@ -2781,13 +2851,16 @@ class CoreWorker:
 
     def _process_task_reply(self, spec: TaskSpec, reply: dict,
                             client: Optional[RpcClient] = None) -> None:
-        self._record_task_event(
-            spec.task_id, spec.name,
-            "failed" if reply.get("error") is not None else "finished",
-            spec.trace_id, spec.parent_span)
         if reply.get("error") is not None:
+            from ray_tpu.core.common import TaskCancelledError
             err = serialization.deserialize(reply["error"],
                                             reply["error_meta"])
+            self._record_task_event(
+                spec.task_id, spec.name,
+                "CANCELLED" if isinstance(err, TaskCancelledError)
+                else "FAILED",
+                spec.trace_id, spec.parent_span,
+                attempt=spec.retry_count, err=repr(err)[:256])
             if spec.streaming:
                 self._fail_stream(spec.task_id, err)
                 return
@@ -2795,6 +2868,9 @@ class CoreWorker:
                 oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
                 self._mark_error(oid.binary(), err)
             return
+        self._record_task_event(spec.task_id, spec.name, "FINISHED",
+                                spec.trace_id, spec.parent_span,
+                                attempt=spec.retry_count)
         if spec.streaming:
             st = self._streams.get(spec.task_id)
             if st is not None:
@@ -2979,8 +3055,9 @@ class CoreWorker:
         )
         spec.trace_id, spec.parent_span = self._trace_for_new_task(tid)
         self._task_arg_refs[tid] = held
-        self._record_task_event(tid, spec.name, "submitted",
-                                spec.trace_id, spec.parent_span)
+        self._record_task_event(tid, spec.name, "SUBMITTED",
+                                spec.trace_id, spec.parent_span,
+                                actor=actor_id)
         if streaming:
             from ray_tpu.core.ref import ObjectRefGenerator
             self._streams[task_id.binary()] = _StreamState()
@@ -3006,8 +3083,14 @@ class CoreWorker:
         try:
             await self._submit_actor_with_retries(spec)
         except BaseException as e:
-            self._record_task_event(spec.task_id, spec.name, "failed",
-                                    spec.trace_id, spec.parent_span)
+            from ray_tpu.core.common import TaskCancelledError
+            self._record_task_event(
+                spec.task_id, spec.name,
+                "CANCELLED" if isinstance(e, TaskCancelledError)
+                else "FAILED",
+                spec.trace_id, spec.parent_span,
+                attempt=spec.retry_count, err=repr(e)[:256],
+                actor=spec.actor_id)
             err = e if isinstance(e, Exception) else WorkerCrashedError(repr(e))
             if spec.streaming:
                 self._fail_stream(spec.task_id, err)
@@ -3222,8 +3305,10 @@ class CoreWorker:
             return
         if spec.task_id not in self._task_arg_refs:
             return  # already settled
-        self._record_task_event(spec.task_id, spec.name, "failed",
-                                spec.trace_id, spec.parent_span)
+        self._record_task_event(spec.task_id, spec.name, "FAILED",
+                                spec.trace_id, spec.parent_span,
+                                attempt=spec.retry_count,
+                                err=repr(err)[:256], actor=spec.actor_id)
         for i in range(spec.num_returns):
             oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
             self._mark_error(oid.binary(), err)
@@ -3546,6 +3631,14 @@ class CoreWorker:
         import inspect as _inspect
 
         first = specs[0]
+        if self._trail_on():
+            node = self.node_id.hex()[:12] if self.node_id else ""
+            wkr = self.worker_id.hex()[:8]
+            for s in specs:
+                self._record_task_event(
+                    s.task_id, s.name, "RUNNING",
+                    attempt=s.retry_count, node=node, worker=wkr,
+                    actor=s.actor_id)
         # Per-caller ordering gate, once for the whole contiguous run.
         if first.seqno != self._actor_seqno.get(first.caller_id, 0):
             ev = asyncio.Event()
@@ -3681,6 +3774,15 @@ class CoreWorker:
 
     async def _execute(self, spec: TaskSpec) -> dict:
         loop = asyncio.get_running_loop()
+        if self._trail_on():
+            # Executor-side transition: the owner can't see RUNNING (it
+            # only sees the push RPC settle), so the executing worker
+            # reports it — node + worker provenance come from here.
+            self._record_task_event(
+                spec.task_id, spec.name, "RUNNING",
+                attempt=spec.retry_count,
+                node=self.node_id.hex()[:12] if self.node_id else "",
+                worker=self.worker_id.hex()[:8], actor=spec.actor_id)
         try:
             if spec.task_id in self._exec_cancelled:
                 self._exec_cancelled.discard(spec.task_id)

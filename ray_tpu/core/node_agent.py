@@ -223,6 +223,16 @@ class NodeAgent:
         self._resource_cv = asyncio.Condition()
         self._lease_ticket_seq = 0
         self._lease_waiters: Dict[int, dict] = {}  # FIFO grant order
+        # grafttrail: node-level batch of task/object transitions. Hosted
+        # workers hand their task batches over one local hop
+        # (report_trail); the agent adds object provenance from the store
+        # journal and its own RPC paths, and a flush tick ships the lot
+        # to the controller fire-and-forget (graftpulse's shape).
+        self._trail_tasks: List[tuple] = []
+        self._trail_objects: List[tuple] = []
+        self._trail_cap = 20000
+        self._trail_on = False  # set from config in start()
+        self._node_hex = self.node_id.hex()[:12]
         self._shutdown = False
 
     # ------------------------------------------------------------------
@@ -276,6 +286,10 @@ class NodeAgent:
         from ray_tpu.core._native import graftpulse
         if graftpulse.enabled():
             spawn(self._pulse_loop())
+        from ray_tpu.core._native import grafttrail
+        self._trail_on = grafttrail.enabled()
+        if self._trail_on:
+            spawn(self._trail_loop())
         if GlobalConfig.memory_monitor_refresh_ms > 0:
             spawn(self._memory_monitor_loop())
         if GlobalConfig.worker_prestart > 0:
@@ -1326,21 +1340,87 @@ class NodeAgent:
     def _drain_fastpath_events(self) -> None:
         """Runs on the event loop when the sidecar journal signals:
         apply the bookkeeping Python owns for objects the C path
-        admitted/deleted."""
+        admitted/deleted. The journal's origin byte (the wire op behind
+        the folded record) becomes grafttrail object provenance: which
+        plane admitted the bytes (shm slab vs staging-file copy) and why
+        a delete happened (explicit / LRU drop / staged reclaim)."""
+        from ray_tpu.core._native import grafttrail
         try:
             events = self._fastpath.drain()
         except Exception as e:
             logger.warning("fastpath drain failed: %r", e)
             return
-        for op, oid, size in events:
+        for op, origin, oid, size in events:
             if op == 1:  # ingest (admitted pinned = primary copy)
                 self._primary[oid] = size
                 ev = self._seal_waiters.pop(oid, None)
                 if ev:
                     ev.set()
+                self._trail_object(
+                    oid, "sealed", size=size,
+                    plane=grafttrail.ORIGIN_PLANE.get(origin, "copy"))
             elif op == 4:  # delete
-                self._primary.pop(oid, None)
+                was_primary = self._primary.pop(oid, None) is not None
                 self._drop_spilled(oid)
+                # An LRU drop (origin 7) evicts an unpinned SECONDARY
+                # copy — the primary elsewhere is still live, so that is
+                # not a free in the ledger's sense.
+                if origin != 7 or was_primary:
+                    self._trail_object(
+                        oid, "freed",
+                        reason=grafttrail.ORIGIN_FREED.get(origin,
+                                                           "delete"))
+            elif op == 9:  # graftshm slab staged (created, not yet sealed)
+                self._trail_object(oid, "created", size=size, plane="shm")
+
+    def _trail_object(self, oid: bytes, op: str, **info) -> None:
+        if not self._trail_on:
+            return
+        from ray_tpu.core._native import grafttrail
+        self._trail_objects.append(grafttrail.object_event(
+            oid.hex(), op, time.time(), node=self._node_hex, **info))
+        drop = len(self._trail_objects) - self._trail_cap
+        if drop > 0:
+            del self._trail_objects[:drop]
+
+    async def report_trail(self, worker_id: bytes, events: list) -> None:
+        """Hosted workers hand their task-transition batches here (one
+        unix-socket hop); the flush tick ships the node's whole batch to
+        the controller."""
+        self._trail_tasks.extend(events)
+        drop = len(self._trail_tasks) - self._trail_cap
+        if drop > 0:
+            del self._trail_tasks[:drop]
+
+    async def trail_residents(self) -> list:
+        """Hex oids this node currently holds (store primaries + spilled
+        copies) — the audit's ground truth for leak reconciliation."""
+        return [o.hex() for o in (set(self._primary) | set(self._spilled))]
+
+    async def _trail_loop(self) -> None:
+        period = max(0.05, GlobalConfig.trail_flush_ms / 1000)
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            await self._trail_flush(timeout=max(period, 1.0))
+
+    async def _trail_flush(self, timeout: float = 1.0) -> None:
+        if not self._trail_tasks and not self._trail_objects:
+            return
+        tasks, self._trail_tasks = self._trail_tasks, []
+        objects, self._trail_objects = self._trail_objects, []
+        try:
+            await asyncio.wait_for(
+                self.controller.call("report_trail_batch",
+                                     self.node_id.binary(), tasks, objects),
+                timeout=timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # Re-buffer (capped) so a controller hiccup isn't data loss.
+            self._trail_tasks = (tasks + self._trail_tasks)[-self._trail_cap:]
+            self._trail_objects = \
+                (objects + self._trail_objects)[-self._trail_cap:]
+            logger.debug("trail push failed: %r", e)
 
     async def store_info(self) -> dict:
         """Store facts a local worker needs for the direct-write put path."""
@@ -1402,6 +1482,8 @@ class NodeAgent:
         ev = self._seal_waiters.pop(oid, None)
         if ev:
             ev.set()
+        self._trail_object(oid, "sealed", size=data_size + meta_size,
+                           plane="fallback")
 
     async def store_seal(self, oid: bytes, owner_addr=None,
                          size: int = 0) -> None:
@@ -1418,6 +1500,10 @@ class NodeAgent:
         ev = self._seal_waiters.pop(oid, None)
         if ev:
             ev.set()
+        self._trail_object(oid, "sealed", size=self._primary.get(oid, size),
+                           plane="fallback",
+                           owner=("%s:%s" % tuple(owner_addr)
+                                  if owner_addr else ""))
         if owner_addr is not None:
             spawn(self._register_location(o, tuple(owner_addr),
                                                           size))
@@ -1515,7 +1601,10 @@ class NodeAgent:
 
     async def store_delete(self, oid: bytes) -> None:
         self.store.delete(ObjectID(oid))
+        was_primary = self._primary.pop(oid, None) is not None
         self._drop_spilled(oid)
+        if was_primary:
+            self._trail_object(oid, "freed", reason="delete")
 
     async def store_contains(self, oid: bytes) -> int:
         c = self.store.contains(ObjectID(oid))
@@ -1732,7 +1821,9 @@ class NodeAgent:
                 self.store.delete(ObjectID(oid))
             except Exception:
                 pass
-            self._primary.pop(oid, None)
+            if self._primary.pop(oid, None) is not None \
+                    or oid in self._spilled:
+                self._trail_object(oid, "freed", reason="delete")
             self._drop_spilled(oid)
 
     def _drop_spilled(self, oid: bytes) -> None:

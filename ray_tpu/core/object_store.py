@@ -282,7 +282,7 @@ class StoreSidecar:
     lifecycle events (ingest/delete) back through `drain()` so Python
     keeps owning the object-lifecycle bookkeeping."""
 
-    EVENT_SIZE = 29  # u8 op | 20B oid | u64 size
+    EVENT_SIZE = 30  # u8 op | u8 origin | 20B oid | u64 size
 
     def __init__(self, store: LocalObjectStore, sock_path: str):
         self._lib = _get_lib()
@@ -296,7 +296,11 @@ class StoreSidecar:
         self._buf = ctypes.create_string_buffer(self.EVENT_SIZE * 256)
 
     def drain(self):
-        """-> [(op, oid_bytes, size)] accumulated since the last call."""
+        """-> [(op, origin, oid_bytes, size)] accumulated since the last
+        call. ``origin`` is the wire op that caused the journal entry
+        (grafttrail provenance: OP_SEAL behind an ingest means the shm
+        plane, OP_DROP behind a delete means a fire-and-forget drop,
+        OP_CREATE behind a delete means a staged-slab reclaim)."""
         out = []
         while True:
             n = self._lib.store_server_drain(self._handle, self._buf,
@@ -304,8 +308,10 @@ class StoreSidecar:
             raw = self._buf.raw[:n]
             for i in range(0, n, self.EVENT_SIZE):
                 rec = raw[i:i + self.EVENT_SIZE]
-                out.append((rec[0], rec[1:21],
-                            int.from_bytes(rec[21:29], "little")))
+                out.append((rec[0],
+                            int.from_bytes(rec[1:2], "little"),
+                            rec[2:22],
+                            int.from_bytes(rec[22:30], "little")))
             if n < len(self._buf):
                 return out
 

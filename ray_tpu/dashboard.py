@@ -6,7 +6,13 @@ React client is out of scope). Endpoints:
 
     GET /                -> minimal HTML overview
     GET /api/summary     -> cluster summary JSON
-    GET /api/nodes|actors|tasks|workers|jobs
+    GET /api/nodes|actors|tasks|workers|jobs|task_events
+    GET /api/state/tasks?state=FAILED&node=ID&name=f&limit=N
+                         -> grafttrail task records (indexed filters)
+    GET /api/state/objects?node=ID&plane=shm&live=1
+                         -> object provenance records
+    GET /api/state/summary -> per-function task rollup
+    GET /api/state/audit   -> conservation audit report
     GET /api/timeline    -> Chrome-trace JSON incl. graftscope native spans
     GET /api/native      -> native hot-path latency rollup (graftscope)
     GET /api/cluster     -> graftpulse SLO view (per-op p50/p99, per-node
@@ -105,11 +111,11 @@ async function tick() {
           a.state === "ALIVE" ? "alive" : "dead"}>${a.state}</span>`
         : a[c] ?? "");
     const byState = {};
-    for (const t of tasks) byState[t.event] =
-        (byState[t.event] || 0) + 1;
+    for (const t of tasks) byState[t.state] =
+        (byState[t.state] || 0) + 1;
     table("tasks", Object.entries(byState).map(
-        ([event, count]) => ({event, count})),
-      ["event","count"], (t, c) => t[c]);
+        ([state, count]) => ({state, count})),
+      ["state","count"], (t, c) => t[c]);
     table("workers", workers, Object.keys(workers[0] || {}),
       (w, c) => fmt(w[c]));
     table("native", native, ["name","count","mean_us","max_us"],
@@ -150,35 +156,68 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 (stdlib naming)
+        from urllib.parse import parse_qs, urlsplit
+
         from ray_tpu import state
         try:
-            if self.path == "/" or self.path == "/index.html":
+            parts = urlsplit(self.path)
+            path = parts.path
+            q = {k: v[0] for k, v in parse_qs(parts.query).items()}
+            if path == "/" or path == "/index.html":
                 self._send(200, _PAGE.encode(), "text/html")
                 return
-            if self.path == "/metrics":
+            if path == "/metrics":
                 self._send(200, state.metrics_text().encode(),
                            "text/plain; version=0.0.4")
                 return
-            if self.path == "/metrics/cluster":
+            if path == "/metrics/cluster":
                 self._send(200, state.cluster_metrics_text().encode(),
                            "text/plain; version=0.0.4")
+                return
+            # grafttrail state API: the ledger-backed views, with query-
+            # string filters riding the same index intersections the CLI
+            # uses (reference: dashboard /api/v0/tasks etc.).
+            if path == "/api/state/tasks" or path == "/api/tasks":
+                self._send(200, json.dumps(state.list_tasks(
+                    state=q.get("state"), node=q.get("node"),
+                    name=q.get("name"), actor=q.get("actor"),
+                    limit=int(q.get("limit", 100))),
+                    default=str).encode())
+                return
+            if path == "/api/state/objects":
+                live = q.get("live")
+                self._send(200, json.dumps(state.list_objects(
+                    node=q.get("node"), plane=q.get("plane"),
+                    live=(None if live is None else live == "1"),
+                    limit=int(q.get("limit", 100))),
+                    default=str).encode())
+                return
+            if path == "/api/state/summary":
+                self._send(200, json.dumps(state.summary_tasks(),
+                                           default=str).encode())
+                return
+            if path == "/api/state/audit":
+                grace = q.get("grace")
+                self._send(200, json.dumps(
+                    state.audit(float(grace) if grace else None),
+                    default=str).encode())
                 return
             routes = {
                 "/api/summary": state.cluster_summary,
                 "/api/nodes": state.list_nodes,
                 "/api/actors": state.list_actors,
-                "/api/tasks": state.list_tasks,
+                "/api/task_events": state.list_task_events,
                 "/api/workers": state.list_workers,
                 "/api/timeline": state.timeline,
                 "/api/native": state.native_latency,
                 "/api/cluster": state.cluster_telemetry,
             }
-            if self.path == "/api/jobs":
+            if path == "/api/jobs":
                 from ray_tpu import job_submission
                 self._send(200, json.dumps(job_submission.list_jobs(),
                                            default=str).encode())
                 return
-            fn = routes.get(self.path)
+            fn = routes.get(path)
             if fn is None:
                 self._send(404, b'{"error": "not found"}')
                 return

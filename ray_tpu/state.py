@@ -43,12 +43,56 @@ def list_actors() -> List[dict]:
     } for a in _ctl("list_actors")]
 
 
-def list_tasks(limit: int = 100) -> List[dict]:
+def list_tasks(state: Optional[str] = None, node: Optional[str] = None,
+               name: Optional[str] = None, actor: Optional[str] = None,
+               limit: int = 100) -> List[dict]:
+    """grafttrail task records (one row per task, newest first), filtered
+    by FSM state (SUBMITTED/LEASED/RUNNING/FINISHED/FAILED/CANCELLED),
+    home node (hex12), function name, or actor id — index intersections
+    on the controller, not scans (reference: `ray list tasks`)."""
+    return _ctl("trail_tasks", state, node, name, actor, limit)
+
+
+def list_task_events(limit: int = 1000) -> List[dict]:
+    """The raw legacy event stream (submitted/finished/... rows) the
+    timeline and event export are derived from."""
     return _ctl("list_task_events", limit)
 
 
+def get_task(task_id: str) -> Optional[dict]:
+    """One task's full trail: attempt chain (per-attempt state, node,
+    worker, transition timestamps), root-cause error across retries,
+    trace linkage. Accepts a unique task-id hex prefix."""
+    return _ctl("trail_task", task_id)
+
+
+def summary_tasks() -> List[dict]:
+    """Per-function rollup: totals, attempts, and per-state counts
+    (reference: `ray summary tasks`)."""
+    return _ctl("trail_summary")
+
+
+def list_objects(node: Optional[str] = None, plane: Optional[str] = None,
+                 live: Optional[bool] = None,
+                 limit: int = 100) -> List[dict]:
+    """grafttrail object records with provenance: plane (shm/copy/
+    fallback), home node, owner, created/sealed/freed timestamps and
+    the freed reason (reference: `ray memory`)."""
+    return _ctl("trail_objects", node, plane, live, limit)
+
+
+def audit(grace_s: Optional[float] = None) -> dict:
+    """Machine-checked conservation audit over the trail ledger: every
+    non-terminal task live on an alive node, every sealed object freed
+    or still resident where the ledger says. Returns {"ok", "lost_tasks",
+    "leaked_objects", "complete", "stats"} with per-finding provenance."""
+    return _ctl("trail_audit", grace_s)
+
+
 def list_workers() -> List[dict]:
-    """Per-node agent stats (workers, store, spill, event stats)."""
+    """Per-node agent stats (workers, store, spill, event stats). A node
+    whose agent can't be reached yields an {"node_id", "error"} row
+    instead of silently vanishing from the listing."""
     cw = _api._cw()
     out = []
     for n in _ctl("get_nodes"):
@@ -59,8 +103,9 @@ def list_workers() -> List[dict]:
                 tuple(n["addr"])).call("agent_stats")).result(15)
             stats["node_id"] = stats["node_id"].hex()[:12]
             out.append(stats)
-        except Exception:
-            pass
+        except Exception as e:
+            out.append({"node_id": n["node_id"].hex()[:12],
+                        "error": repr(e)})
     return out
 
 

@@ -99,8 +99,10 @@ def _fold(node: ast.AST) -> ast.AST:
 
 
 def _rec_slices(drain: ast.FunctionDef) -> Dict[str, Tuple[int, int]]:
-    """Read drain()'s `rec[a:b]` subscripts: offset 0 byte = op, the
-    first multi-byte slice = oid, the second = size."""
+    """Read drain()'s `rec[a:b]` subscripts: offset 0 byte = op. With
+    three slices (the grafttrail journal layout) they are, in offset
+    order, origin / oid / size; with two (the legacy layout) the first
+    multi-byte slice = oid, the second = size."""
     pairs: List[Tuple[int, int]] = []
     for node in ast.walk(drain):
         if not (isinstance(node, ast.Subscript)
@@ -114,10 +116,10 @@ def _rec_slices(drain: ast.FunctionDef) -> Dict[str, Tuple[int, int]]:
             pairs.append((sl.lower.value, sl.upper.value))
     pairs.sort()
     fields: Dict[str, Tuple[int, int]] = {"op": (0, 1)}
-    if len(pairs) >= 1:
-        fields["oid"] = (pairs[0][0], pairs[0][1] - pairs[0][0])
-    if len(pairs) >= 2:
-        fields["size"] = (pairs[1][0], pairs[1][1] - pairs[1][0])
+    names = (["origin", "oid", "size"] if len(pairs) >= 3
+             else ["oid", "size"])
+    for name, (lo, hi) in zip(names, pairs):
+        fields[name] = (lo, hi - lo)
     return fields
 
 

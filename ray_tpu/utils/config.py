@@ -140,6 +140,11 @@ _flag("pulse_suspect_ticks", int, 2, "Missed pulses before the controller marks 
 _flag("pulse_dead_ms", int, 8000, "Pulse silence before a suspect node is declared dead (actors restarted, owned objects re-resolved). Heartbeat liveness still applies independently.")
 _flag("pulse_history", int, 300, "Pulse samples retained per node in the controller ring buffer.")
 _flag("event_buffer_max", int, 4096, "Max buffered (unflushed) events in the exporter; beyond this the oldest are dropped and counted in the events_dropped gauge.")
+_flag("grafttrail", bool, True, "State-observability plane (grafttrail): workers emit per-attempt task lifecycle transitions (SUBMITTED/LEASED/RUNNING/FINISHED/FAILED/CANCELLED) and agents export the store journal as object provenance; batches ride the worker flush tick and a fire-and-forget agent->controller path into the indexed controller ledger behind `ray_tpu list/summary/get/audit`. RAY_TPU_GRAFTTRAIL=0 falls back to the legacy submitted/finished/failed pipeline.")
+_flag("trail_flush_ms", int, 1000, "grafttrail agent->controller batch period.")
+_flag("trail_task_cap", int, 20000, "Task records retained in the controller trail ledger (terminal records evict first; drops are counted).")
+_flag("trail_object_cap", int, 50000, "Object records retained in the controller trail ledger (freed records evict first; drops are counted).")
+_flag("trail_audit_grace_s", float, 300.0, "Audit grace: a non-terminal task with no transition for this long counts as lost.")
 _flag("autoscale_p99_ms", float, 0.0, "Scale up when the cluster-wide native op p99 (from graftpulse histograms) exceeds this many milliseconds while work is queued; 0 disables the latency signal.")
 
 

@@ -81,6 +81,10 @@ class EventExporter:
                 max_buffered = 4096
         self._max = max(1, max_buffered)
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # Interpreter exit must not strand a partial batch in the buffer
+        # (events below _FLUSH_EVERY would otherwise never hit the sink).
+        import atexit
+        atexit.register(self.flush)
 
     def emit(self, source: str, event: Any) -> None:
         rec = {"ts": time.time(), "mono_ns": time.monotonic_ns(),

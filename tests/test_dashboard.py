@@ -67,9 +67,19 @@ def test_tasks_workers_jobs_endpoints(dash):
 
     assert ray_tpu.get(traced.remote()) == 7
 
-    status, body = _get(dash, "/api/tasks")
-    assert status == 200
-    tasks = json.loads(body)
+    # Trail records ride the worker flush tick -> agent tick -> ledger;
+    # poll briefly instead of racing the pipeline.
+    import time
+    deadline = time.monotonic() + 20
+    tasks = []
+    while time.monotonic() < deadline:
+        status, body = _get(dash, "/api/tasks")
+        assert status == 200
+        tasks = json.loads(body)
+        if any(t.get("state") == "FINISHED" or t.get("event")
+               for t in tasks):
+            break
+        time.sleep(0.25)
     assert isinstance(tasks, list) and tasks
     assert any(t.get("state") == "FINISHED" or t.get("event")
                for t in tasks), tasks[:3]

@@ -4,10 +4,14 @@ sink (reference: export-API aggregator pipeline; SURVEY §5.5 events).
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import ray_tpu
 from ray_tpu.utils.config import GlobalConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_event_export_jsonl(tmp_path):
@@ -89,3 +93,61 @@ def test_event_exporter_unit_flush_and_resilience(tmp_path):
     os.makedirs(str(tmp_path / "dir-as-file"), exist_ok=True)
     bad.emit("x", {"a": 1})
     bad.flush()
+
+
+def test_event_exporter_atexit_drains_partial_batch(tmp_path):
+    """Interpreter exit must not strand events below the batch size —
+    the exporter registers an atexit flush, so a process that emits a
+    handful of events and exits WITHOUT flushing still lands them."""
+    sink = str(tmp_path / "atexit.jsonl")
+    script = (
+        "from ray_tpu.utils.events import EventExporter\n"
+        f"ex = EventExporter({sink!r})\n"
+        "ex.emit('tail', {'k': 1})\n"
+        "ex.emit('tail', {'k': 2})\n"
+        "# no flush(): atexit must drain these two\n")
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=60,
+                         cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    recs = [json.loads(ln) for ln in open(sink)]
+    assert [r["event"]["k"] for r in recs
+            if r["source"] == "tail"] == [1, 2]
+
+
+def test_controller_stop_flushes_exporter(tmp_path):
+    """A short-lived cluster whose event volume never reaches the batch
+    size still exports everything: shutdown_controller flushes the sink
+    before closing (plus the atexit net under it)."""
+    sink = str(tmp_path / "stop.jsonl")
+    GlobalConfig.initialize({"event_export_path": sink})
+    from ray_tpu.core.cluster_utils import Cluster
+    c = Cluster(num_nodes=1, resources={"CPU": 2})
+    c.connect()
+    try:
+        @ray_tpu.remote
+        def once():
+            return 42
+
+        assert ray_tpu.get(once.remote(), timeout=60) == 42
+        from ray_tpu import api
+        api._cw()._flush_task_events()
+        # Give the worker->agent->controller relay a moment to land the
+        # rows in the controller's buffer (NOT necessarily the sink).
+        time.sleep(3.0)
+    finally:
+        c.shutdown()
+        GlobalConfig._overrides.clear()
+        GlobalConfig._cache.clear()
+    deadline = time.monotonic() + 15
+    events = []
+    while time.monotonic() < deadline:
+        if os.path.exists(sink):
+            events = [json.loads(ln) for ln in open(sink)]
+            if any(e["source"] == "task_events" and
+                   e["event"].get("name") == "once" for e in events):
+                break
+        time.sleep(0.3)
+    assert any(e["source"] == "task_events" and
+               e["event"].get("name") == "once" for e in events), \
+        sorted({e["source"] for e in events})

@@ -75,10 +75,13 @@ def test_create_seal_inplace_roundtrip(tmp_path):
         assert back["b"] == value["b"]
         client.release(oid)
 
-        # Seal journaled as ingest (op 1) so agent bookkeeping is
-        # op-agnostic; delete returns the slab to the warm free list.
+        # CREATE journals its own record (origin 9), then the seal rides
+        # as an ingest (op 1) whose origin byte pins the shm plane, so
+        # agent bookkeeping stays op-agnostic; delete returns the slab to
+        # the warm free list.
         events = sidecar.drain()
-        assert (1, oid, gds + gms) in events, events
+        assert (9, 9, oid, gds + gms) in events, events
+        assert (1, 10, oid, gds + gms) in events, events
         assert client.delete(oid) == 0
 
         oid2 = ObjectID.random().binary()

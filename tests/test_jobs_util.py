@@ -93,3 +93,43 @@ def test_queue_blocking_and_timeout(cluster):
     q.put("hello")
     assert ray_tpu.get(ref, timeout=60) == "hello"
     q.shutdown()
+
+
+def test_job_logs_tail_and_follow_cli(cluster, tmp_path):
+    """`job logs --tail N` prints only the last N lines; `-f` streams
+    until the job reaches a terminal status (here: already finished, so
+    it prints everything and exits)."""
+    import os
+    import subprocess
+    import sys
+
+    from ray_tpu import api
+    from ray_tpu import job_submission as jobs
+
+    script = tmp_path / "chatty.py"
+    script.write_text(
+        "for i in range(6):\n"
+        "    print(f'line-{i}')\n")
+    job_id = jobs.submit_job(f"python {script}")
+    assert jobs.wait_job(job_id, timeout=120) == "SUCCEEDED"
+
+    host, port = api._cw().controller_addr
+    addr = f"{host}:{port}"
+    env = dict(os.environ)
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.cli", "job", "logs",
+         "--job-id", job_id, "--tail", "2", "--address", addr],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("line-")]
+    assert lines == ["line-4", "line-5"], out.stdout
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.cli", "job", "logs",
+         "--job-id", job_id, "-f", "--interval", "0.2",
+         "--address", addr],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr
+    for i in range(6):
+        assert f"line-{i}" in out.stdout, out.stdout

@@ -290,6 +290,39 @@ def test_wire_schema_detects_struct_width_change(tmp_path):
         [f.render() for f in fs]
 
 
+def test_wire_schema_detects_origin_width_drift(tmp_path):
+    # grafttrail provenance rides the journal's origin byte; widening it
+    # on the C side shifts every field behind it, so the pass must flag
+    # both the origin itself and the knock-on oid/size displacement.
+    cc = _mutated_cc(tmp_path, "uint8_t origin;", "uint16_t origin;")
+    fs = wire_schema.run(STORE_PY, cc, "py", "cc")
+    assert fs and all(f.rule == "wire-drift" for f in fs)
+    assert any("origin" in f.message for f in fs), \
+        [f.render() for f in fs]
+    assert any("oid" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_wire_schema_detects_origin_slice_drift(tmp_path):
+    # Python reading a 2-byte origin that C packs as a single byte.
+    py = _mutated(tmp_path, STORE_PY, "rec[1:2]", "rec[1:3]",
+                  "object_store.py")
+    fs = wire_schema.run(py, STORE_CC, "py", "cc")
+    assert fs and any("origin" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_wire_schema_detects_origin_drain_clobber(tmp_path):
+    # A drain memcpy landing the oid at offset 1 silently overwrites the
+    # origin byte — every object record decodes with plane "copy".
+    cc = _mutated_cc(tmp_path,
+                     "std::memcpy(buf + n + 2, e.oid, kIdSize);",
+                     "std::memcpy(buf + n + 1, e.oid, kIdSize);")
+    fs = wire_schema.run(STORE_PY, cc, "py", "cc")
+    assert fs and any("oid" in f.message and "offset 1" in f.message
+                      for f in fs), [f.render() for f in fs]
+
+
 # ---------------------------------------------------------------------------
 # pass 3b — RPC handler-signature drift
 # ---------------------------------------------------------------------------

@@ -51,10 +51,11 @@ def test_fastpath_sidecar_roundtrip(tmp_path):
         # covered by the C suite); delete removes it.
         assert client.delete(oid.binary()) == 0
         assert client.contains(oid.binary()) == 0
-        # Journal: ingest then delete, sizes included.
+        # Journal: ingest then delete, each tagged with its wire origin.
         events = sidecar.drain()
-        assert (1, oid.binary(), len(payload)) in events
-        assert any(op == 4 and o == oid.binary() for op, o, _ in events)
+        assert (1, 1, oid.binary(), len(payload)) in events
+        assert any(op == 4 and o == oid.binary()
+                   for op, _origin, o, _ in events)
         # Path traversal refused at the C layer.
         assert client.ingest(oid.binary(), "../evil", 1, 0) == -4
     finally:

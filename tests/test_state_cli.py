@@ -6,6 +6,7 @@ metrics agent pipeline) at this framework's scale.
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -56,7 +57,7 @@ def test_task_events_and_timeline(cluster, tmp_path):
     api._cw()._flush_task_events()
     deadline = time.monotonic() + 10
     while time.monotonic() < deadline:
-        tasks = state.list_tasks(limit=1000)
+        tasks = state.list_task_events(limit=1000)
         names = [t["name"] for t in tasks]
         if names.count("traced_task") >= 10:  # submitted + finished
             break
@@ -192,7 +193,7 @@ def test_trace_propagation_across_processes(cluster):
 
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
-        events = state.list_tasks(limit=1000)
+        events = state.list_task_events(limit=1000)
         roots = [e for e in events if e["name"] == "root_task"
                  and e["event"] == "submitted"]
         leaves = [e for e in events if e["name"] == "leaf"
@@ -222,3 +223,71 @@ def test_trace_propagation_across_processes(cluster):
     assist_id = tree_assists[-1]["task_id"]
     assert any(e["parent_span"] == assist_id for e in tree_leaves), \
         [(e["task_id"][:8], e["parent_span"][:8]) for e in tree_leaves]
+
+
+def test_list_workers_and_stack_surface_agent_errors(cluster,
+                                                     monkeypatch):
+    """An unreachable agent must not silently vanish from the listing:
+    list_workers yields an {"node_id", "error"} row and stack() an
+    {"error"} entry, both keyed by the node they describe."""
+    from ray_tpu import api
+
+    cw = api._cw()
+
+    def boom(addr):
+        raise RuntimeError("agent-unreachable")
+
+    monkeypatch.setattr(cw, "_client_for_worker", boom)
+    rows = state.list_workers()
+    assert rows, "ALIVE node produced no row at all"
+    assert all(set(r) == {"node_id", "error"} for r in rows), rows
+    assert "agent-unreachable" in rows[0]["error"]
+    node_hex = state.list_nodes()[0]["node_id"]
+    assert rows[0]["node_id"] == node_hex
+
+    dump = state.stack()
+    assert dump[node_hex].get("error"), dump
+    assert "agent-unreachable" in dump[node_hex]["error"]
+
+
+def test_timeline_atomic_write_under_concurrent_reader(cluster,
+                                                       tmp_path):
+    """timeline(filename) dumps via tmp + rename: a reader polling the
+    path may see 'not there yet' but never a torn/partial JSON file."""
+    import threading
+
+    @ray_tpu.remote
+    def tick(x):
+        return x
+
+    ray_tpu.get([tick.remote(i) for i in range(3)])
+    from ray_tpu import api
+    api._cw()._flush_task_events()
+
+    out = str(tmp_path / "trace.json")
+    stop = threading.Event()
+    torn: list = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                with open(out) as f:
+                    json.load(f)
+            except FileNotFoundError:
+                pass  # writer hasn't produced the first dump yet
+            except json.JSONDecodeError as e:
+                torn.append(repr(e))
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        for _ in range(15):
+            trace = state.timeline(out)
+            assert isinstance(trace, list)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not torn, torn
+    assert not os.path.exists(out + ".tmp")  # tmp never left behind
+    assert json.load(open(out))  # final dump is whole
