@@ -9,10 +9,12 @@ cd "$(dirname "$0")"
 
 echo "=== static analysis ==="
 # graftlint: event-loop safety, lock discipline, Python<->C wire-schema
-# drift, RPC handler-signature drift, task/coroutine leaks — plus the
-# graftgate passes: store-protocol state machine vs tools/lint/
-# protocol.json (4a), csrc memory-order discipline (4b), error-path
-# fd/inode leaks (4c). First gate: nothing else runs if this fails.
+# drift (store 3a, graftrpc 3c, ctypes 3d, graftscope 3e, graftpulse 3f
+# incl. the version->size registry, graftprof 3g), RPC handler-signature
+# drift, task/coroutine leaks — plus the graftgate passes: store-protocol
+# state machine vs tools/lint/protocol.json (4a), csrc memory-order
+# discipline (4b), error-path fd/inode leaks (4c). First gate: nothing
+# else runs if this fails.
 python -m ray_tpu.tools.lint
 
 echo "=== stage 1: fast suite ==="
@@ -33,8 +35,9 @@ python -m pytest tests/test_ops.py tests/test_model_parallel.py \
 
 echo "=== native-plane sanitizers ==="
 # make tsan / make asan via the pytest wrapper: store sidecar, graftrpc
-# reactor, graftcopy engine, and the graftscope ring buffers (the
-# lock-free drain-while-writing storm runs under ThreadSanitizer here).
+# reactor, graftcopy engine, graftshm arena, the graftscope ring buffers
+# (the lock-free drain-while-writing storm runs under ThreadSanitizer
+# here) and the graftprof sampler ring (drain-while-sampling).
 RAY_TPU_SANITIZER_TESTS=1 python -m pytest \
     tests/test_native_store.py::test_native_store_sanitizers -q
 

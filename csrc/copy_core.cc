@@ -39,6 +39,7 @@
 #include <fcntl.h>
 #include <unistd.h>
 
+#include "prof_core.h"
 #include "scope_core.h"
 
 extern "C" {
@@ -115,6 +116,7 @@ struct Engine {
 };
 
 void WorkerLoop(Engine* e) {
+  prof_register_thread("graftcopy-worker");
   std::unique_lock<std::mutex> lk(e->mu);
   for (;;) {
     while (!e->stopping && e->queue.empty()) e->cv_work.wait(lk);

@@ -31,6 +31,8 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include "prof_core.h"
+
 namespace {
 
 constexpr int kIdSize = 20;
@@ -107,6 +109,7 @@ void LruRemove(Store* s, ObjectEntry* e) {
 constexpr size_t kMaxTrashBacklog = 256;
 
 void ReaperLoop(Store* s) {
+  prof_register_thread("store-reaper");
   std::unique_lock<std::mutex> lk(s->mu);
   while (!s->stopping) {
     if (s->trash.empty()) {

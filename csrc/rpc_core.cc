@@ -56,6 +56,7 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include "prof_core.h"
 #include "scope_core.h"
 
 namespace {
@@ -341,6 +342,7 @@ bool HandleCommands(Endpoint* ep) {  // returns false on stop
 
 void* ReactorLoop(void* argp) {
   auto* ep = static_cast<Endpoint*>(argp);
+  prof_register_thread("graftrpc-reactor");
   epoll_event evs[64];
   for (;;) {
     int n = ::epoll_wait(ep->epfd, evs, 64, -1);

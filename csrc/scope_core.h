@@ -59,7 +59,7 @@ static_assert(sizeof(ScopeWireRec) == kScopeRecordSize, "record packing");
 // {calls, bytes, ns} counter deltas then the histogram bucket deltas.
 // Lint pass 3f keeps both sides in sync.
 #pragma pack(push, 1)
-struct PulseWireRec {  // 96 bytes on the wire, little-endian
+struct PulseWireRec {  // 104 bytes on the wire, little-endian
   uint32_t magic;         // 'PLSE' = 0x45534c50
   uint16_t version;
   uint16_t kind_count;    // scope kinds in the trailing payload
@@ -76,13 +76,25 @@ struct PulseWireRec {  // 96 bytes on the wire, little-endian
   uint64_t rss_bytes;        // summed worker RSS
   uint64_t scope_dropped;
   uint64_t events_dropped;
+  uint32_t prof_oncpu_permille;  // graftprof: worker on-CPU share, 0..1000
+  uint32_t prof_gil_permille;    // graftprof: GIL-wait share, 0..1000
 };
 #pragma pack(pop)
 
-constexpr int kPulseRecordSize = 96;
+// v2 appended the two graftprof permille gauges (was 96 bytes at v1).
+// Widening this struct without bumping kPulseVersion is a lint error
+// (pass 3f keeps a version -> size registry on both sides).
+constexpr int kPulseRecordSize = 104;
 static_assert(sizeof(PulseWireRec) == kPulseRecordSize, "pulse packing");
 [[maybe_unused]] constexpr uint32_t kPulseMagic = 0x45534c50;
-[[maybe_unused]] constexpr uint16_t kPulseVersion = 1;
+[[maybe_unused]] constexpr uint16_t kPulseVersion = 2;
+// Version -> header size, one row per wire revision ever shipped.
+// Append-only; the current version's row must equal kPulseRecordSize.
+// Mirrored by PULSE_VERSION_SIZES in graftpulse.py (lint pass 3f).
+[[maybe_unused]] constexpr int kPulseVersionSizes[][2] = {
+    {1, 96},   // v1: through events_dropped
+    {2, 104},  // v2: + graftprof on-CPU / GIL permille gauges
+};
 
 extern "C" {
 

@@ -70,6 +70,7 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include "prof_core.h"
 #include "scope_core.h"
 #include "shm_core.h"
 
@@ -182,6 +183,7 @@ void* ConnLoop(void* argp) {
   Server* s = args->server;
   int fd = args->fd;
   delete args;
+  prof_register_thread("sidecar-conn");
   {
     std::lock_guard<std::mutex> g(s->mu);
     s->conn_fds.push_back(fd);
@@ -421,6 +423,7 @@ void* ConnLoop(void* argp) {
 
 void* AcceptLoop(void* argp) {
   Server* s = static_cast<Server*>(argp);
+  prof_register_thread("sidecar-accept");
   for (;;) {
     int fd = ::accept(s->listen_fd, nullptr, nullptr);
     if (fd < 0) {

@@ -31,15 +31,22 @@ def is_initialized() -> bool:
 
 def init(address: Optional[str] = None, *,
          resources: Optional[Dict[str, float]] = None,
-         agent_address: Optional[str] = None) -> Dict[str, Any]:
+         agent_address: Optional[str] = None,
+         graftprof: Optional[bool] = None) -> Dict[str, Any]:
     """Start a local cluster (head) or connect to an existing controller.
 
     address: "host:port" of a running controller; None starts controller +
     node agent locally (the reference's `ray.init()` head path).
+    graftprof: override the continuous-profiling flag for this process
+    and its spawned workers (None = config/env default; the
+    RAY_TPU_GRAFTPROF=0 escape hatch reaches the same flag).
     """
     global _global_node, _core_worker
     if _core_worker is not None:
         return {"already_initialized": True}
+    if graftprof is not None:
+        from ray_tpu.utils.config import GlobalConfig
+        GlobalConfig.initialize({"graftprof": bool(graftprof)})
     if address is None:
         # Driver scripts launched by job submission (and the reference's
         # RAY_ADDRESS convention) connect via env.

@@ -12,6 +12,9 @@ Analogue of the reference's CLI (reference: python/ray/scripts/scripts.py
     python -m ray_tpu.cli get task ID --address ...
     python -m ray_tpu.cli audit --address ...
     python -m ray_tpu.cli timeline --address ... --out trace.json
+    python -m ray_tpu.cli stack --address ... [--profile N]
+    python -m ray_tpu.cli prof top --address ... [--task F] [--seconds N]
+    python -m ray_tpu.cli prof flame --address ... -o out.json|out.collapsed
     python -m ray_tpu.cli metrics --address ...
     python -m ray_tpu.cli stop --address ...
 """
@@ -109,15 +112,20 @@ def _status_live(interval: float) -> int:
             f"rss {tot.get('rss_bytes', 0) / 2**20:.0f} MiB",
             "",
             f"{'node':<14}{'health':<10}{'seq':>6}{'queue':>7}"
-            f"{'objects':>9}{'store MiB':>11}{'rss MiB':>9}",
+            f"{'objects':>9}{'store MiB':>11}{'rss MiB':>9}"
+            f"{'cpu%':>7}{'gil%':>7}",
         ]
         for nid, n in sorted(t.get("nodes", {}).items()):
+            # graftprof gauges ride the pulse: worker on-CPU share and
+            # GIL-wait share (permille) make hot nodes stand out.
             lines.append(
                 f"{nid:<14}{n.get('health', '?'):<10}"
                 f"{n.get('seq', 0):>6}{n.get('queue_depth', 0):>7}"
                 f"{n.get('store_objects', 0):>9}"
                 f"{n.get('store_used', 0) / 2**20:>11.1f}"
-                f"{n.get('rss_bytes', 0) / 2**20:>9.0f}")
+                f"{n.get('rss_bytes', 0) / 2**20:>9.0f}"
+                f"{n.get('prof_oncpu_permille', 0) / 10:>7.1f}"
+                f"{n.get('prof_gil_permille', 0) / 10:>7.1f}")
         ops = t.get("ops", {})
         if ops:
             lines += ["", f"{'native op':<22}{'calls':>9}{'p50 us':>9}"
@@ -183,7 +191,9 @@ def cmd_summary(args) -> int:
 
 
 def cmd_get(args) -> int:
-    """Full trail for one task: attempt chain + root cause."""
+    """Full trail for one task: attempt chain + root cause, joined with
+    the task's graftprof accounting (on-CPU% / GIL-wait% of sampled
+    wall time) when the profiling plane has seen it."""
     _connect(args.address)
     from ray_tpu import state
     detail = state.get_task(args.id)
@@ -191,6 +201,17 @@ def cmd_get(args) -> int:
         print(f"no task matching {args.id!r} (need a unique id prefix)",
               file=sys.stderr)
         return 1
+    try:
+        prof = state.prof_task_stats(args.id)
+    except Exception:
+        prof = None
+    if prof:
+        wall = max(1, int(prof.get("wall_ns") or 0))
+        detail["prof"] = {
+            "samples": prof.get("samples", 0),
+            "oncpu_pct": round(100.0 * prof.get("oncpu_ns", 0) / wall, 1),
+            "gil_wait_pct": round(100.0 * prof.get("gil_ns", 0) / wall, 1),
+        }
     print(json.dumps(detail, indent=2, default=str))
     return 0
 
@@ -233,11 +254,39 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def _print_folded(folded: dict, indent: str = "  ") -> None:
+    """Render a graftprof capture ({frames, stacks, samples,
+    thread_cpu_ns}) as collapsed stacks sorted hottest-first, plus the
+    per-thread native CPU table (sidecar threads included)."""
+    frames = folded.get("frames") or []
+    rows = []
+    for row in folded.get("stacks") or []:
+        try:
+            task, actor, name, idxs, n = row
+            stack = ";".join(frames[i] for i in idxs)
+        except Exception:
+            continue
+        rows.append((int(n), name or task[:12] or "-", stack))
+    total = folded.get("samples") or sum(n for n, _, _ in rows) or 1
+    print(f"{indent}{len(rows)} distinct stacks, {total} samples")
+    for n, who, stack in sorted(rows, key=lambda r: -r[0]):
+        print(f"{indent}{n:>6} {100.0 * n / total:5.1f}%  "
+              f"[{who}] {stack}")
+    cpu = folded.get("thread_cpu_ns") or []
+    if cpu:
+        print(f"{indent}-- native thread CPU --")
+        for name, ns in sorted(cpu, key=lambda r: -r[1]):
+            print(f"{indent}{ns / 1e6:>10.1f} ms  {name}")
+
+
 def cmd_stack(args) -> int:
-    """Dump every worker's Python stacks (reference: `ray stack`)."""
+    """Dump every worker's Python stacks (reference: `ray stack`).
+    --profile N folds N seconds of graftprof samples per worker instead
+    of a single snapshot and appends native thread CPU times."""
     _connect(args.address)
     from ray_tpu import state
-    dump = state.stack(args.node)
+    profile_s = getattr(args, "profile", 0.0) or 0.0
+    dump = state.stack(args.node, profile_s=profile_s)
     for nid, workers in dump.items():
         print(f"=== node {nid} ===")
         if "error" in workers:
@@ -247,12 +296,66 @@ def cmd_stack(args) -> int:
             who = f"actor {entry['actor']}" if entry.get("actor") \
                 else f"worker {entry.get('worker_id', '?')}"
             print(f"--- pid {pid} ({who}, via {entry.get('via', '?')}) ---")
-            for name, text in entry.get("stacks", {}).items():
-                print(f"  [{name}]")
-                for line in text.splitlines():
-                    print(f"    {line}")
+            stacks = entry.get("stacks", {})
+            if isinstance(stacks, dict) and "frames" in stacks:
+                _print_folded(stacks)
+            else:
+                for name, text in stacks.items():
+                    print(f"  [{name}]")
+                    for line in text.splitlines():
+                        print(f"    {line}")
             if entry.get("error"):
                 print(f"  <error: {entry['error']}>")
+    return 0
+
+
+def cmd_prof(args) -> int:
+    """The graftprof surfaces: `prof top` (hottest frames with self/cum
+    sample counts) and `prof flame -o out.json|out.collapsed`
+    (d3-flamegraph JSON or Brendan-Gregg collapsed stacks). Profiles
+    are already on the controller — no attach step, no target pid
+    (reference contrast: `ray stack`/py-spy attach on demand)."""
+    _connect(args.address)
+    from ray_tpu import state
+    filt = dict(task=args.task, actor=args.actor, node=args.node,
+                seconds=args.seconds)
+    if args.action == "top":
+        top = state.prof_top(limit=args.limit, **filt)
+        total = top.get("total_samples", 0)
+        if not total:
+            print("no profile samples matched (is graftprof on? "
+                  "RAY_TPU_GRAFTPROF=0 disables it)")
+            return 1
+        print(f"{'self%':>7}{'cum%':>7}{'self':>8}{'cum':>8}  function "
+              f"({total} samples)")
+        for r in top["rows"]:
+            print(f"{r['self_pct']:>6.1f}%{r['cum_pct']:>6.1f}%"
+                  f"{r['self']:>8}{r['cum']:>8}  {r['func']}")
+        native = top.get("native_threads") or []
+        if native:
+            print("-- native thread CPU (process-wide) --")
+            for name, ns in native:
+                print(f"{ns / 1e6:>10.1f} ms  {name}")
+        return 0
+    # flame
+    out = args.out or "flame.json"
+    if out.endswith(".collapsed"):
+        lines = state.prof_collapsed(**filt)
+        if not lines:
+            print("no profile samples matched", file=sys.stderr)
+            return 1
+        with open(out, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"wrote {len(lines)} collapsed stacks to {out}")
+    else:
+        flame = state.prof_flame(**filt)
+        if not flame.get("value"):
+            print("no profile samples matched", file=sys.stderr)
+            return 1
+        with open(out, "w") as f:
+            json.dump(flame, f)
+        print(f"wrote d3-flamegraph JSON ({flame['value']} samples) "
+              f"to {out}")
     return 0
 
 
@@ -403,7 +506,28 @@ def main(argv=None) -> int:
     sp.add_argument("--address", required=True)
     sp.add_argument("--node", default=None,
                     help="node id prefix (default: all nodes)")
+    sp.add_argument("--profile", type=float, default=0.0, metavar="N",
+                    help="fold N seconds of graftprof samples per "
+                         "worker instead of one snapshot")
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser("prof", help="continuous-profiling surfaces "
+                        "(always-on graftprof plane)")
+    sp.add_argument("action", choices=["top", "flame"])
+    sp.add_argument("--address", required=True)
+    sp.add_argument("--task", default=None,
+                    help="task id prefix or exact task/function name")
+    sp.add_argument("--actor", default=None, help="actor id prefix")
+    sp.add_argument("--node", default=None, help="node id (hex12)")
+    sp.add_argument("--seconds", type=float, default=None,
+                    help="only samples from the last N seconds "
+                         "(default: merged per-task history)")
+    sp.add_argument("--limit", type=int, default=30,
+                    help="top: max rows")
+    sp.add_argument("-o", "--out", default=None,
+                    help="flame: output path — .json (d3-flamegraph) "
+                         "or .collapsed (flamegraph.pl input)")
+    sp.set_defaults(fn=cmd_prof)
 
     sp = sub.add_parser("timeline")
     sp.add_argument("--address", required=True)

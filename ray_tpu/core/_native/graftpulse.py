@@ -17,10 +17,14 @@ src/ray/gcs/; here one fixed-width frame carries resources, latency
 SLOs and liveness at once).
 
 Wire layout (lint pass 3f cross-checks the constants below against
-``struct PulseWireRec`` in csrc/scope_core.h): a 96-byte little-endian
+``struct PulseWireRec`` in csrc/scope_core.h): a 104-byte little-endian
 header followed by ``kind_count`` rows of ``3 + PULSE_HIST_BUCKETS``
 u64s — per scope kind the {calls, bytes, ns} deltas since the previous
-pulse, then the histogram bucket deltas.
+pulse, then the histogram bucket deltas. Version 2 appended the two
+graftprof gauges (worker on-CPU share and GIL-wait share, in permille)
+so ``status --live`` can rank hot nodes without a second RPC; widening
+the header without bumping PULSE_VERSION is a lint error (pass 3f
+checks the version -> size registry on both sides).
 
 Everything degrades gracefully: with the native library absent the
 scope sections are empty, and ``RAY_TPU_GRAFTPULSE=0`` (or
@@ -41,7 +45,12 @@ from ray_tpu.core._native import graftscope
 # --- wire constants (lint-checked against csrc/scope_core.h, pass 3f) -----
 
 PULSE_MAGIC = 0x45534C50  # 'PLSE'
-PULSE_VERSION = 1
+PULSE_VERSION = 2
+
+# Every wire version ever shipped -> its header size. Appending fields
+# means a new entry here (and in the mirror table in scope_core.h's
+# lint pass); silently widening an existing version is schema drift.
+PULSE_VERSION_SIZES = {1: 96, 2: 104}
 
 # Log2 histogram geometry (kScopeHistBuckets / kScopeHistShift): bucket b
 # counts emits whose dur_ns landed in [2^(SHIFT+b), 2^(SHIFT+b+1)), both
@@ -67,9 +76,11 @@ PULSE_RECORD_FIELDS = (
     ("rss_bytes", 8),
     ("scope_dropped", 8),
     ("events_dropped", 8),
+    ("prof_oncpu_permille", 4),
+    ("prof_gil_permille", 4),
 )
-PULSE_RECORD = struct.Struct("<IHHQQQQQIIQIIQQQ")
-PULSE_RECORD_SIZE = 96
+PULSE_RECORD = struct.Struct("<IHHQQQQQIIQIIQQQII")
+PULSE_RECORD_SIZE = 104
 
 _ROW_WORDS = 3 + PULSE_HIST_BUCKETS  # calls, bytes, ns, b0..b15
 
@@ -88,6 +99,10 @@ class Pulse(NamedTuple):
     rss_bytes: int
     scope_dropped: int
     events_dropped: int
+    # graftprof: worker on-CPU and GIL-wait shares over the last tick,
+    # in permille of wall time (0..1000; 0 when graftprof is off).
+    prof_oncpu_permille: int
+    prof_gil_permille: int
     # kind_name -> (calls, bytes, ns, (b0..b15)) — deltas for this tick.
     kinds: Dict[str, Tuple[int, int, int, Tuple[int, ...]]]
 
@@ -118,7 +133,9 @@ def encode(p: Pulse) -> bytes:
         p.shm_arena_bytes,
         min(p.num_workers, 0xFFFFFFFF),
         min(p.queue_depth, 0xFFFFFFFF),
-        p.rss_bytes, p.scope_dropped, p.events_dropped)
+        p.rss_bytes, p.scope_dropped, p.events_dropped,
+        min(p.prof_oncpu_permille, 0xFFFFFFFF),
+        min(p.prof_gil_permille, 0xFFFFFFFF))
     words: List[int] = []
     for kind in range(kind_count):
         row = p.kinds.get(graftscope.KIND_NAMES.get(kind, ""))
@@ -141,8 +158,9 @@ def decode(buf: bytes) -> Pulse:
         raise ValueError("pulse frame truncated")
     (magic, version, kind_count, seq, t_mono_ns, t_wall_ns, store_used,
      store_capacity, store_objects, shm_free_chunks, shm_arena_bytes,
-     num_workers, queue_depth, rss_bytes, scope_dropped,
-     events_dropped) = PULSE_RECORD.unpack_from(buf, 0)
+     num_workers, queue_depth, rss_bytes, scope_dropped, events_dropped,
+     prof_oncpu_permille, prof_gil_permille) = \
+        PULSE_RECORD.unpack_from(buf, 0)
     if magic != PULSE_MAGIC:
         raise ValueError("bad pulse magic 0x%x" % magic)
     if version != PULSE_VERSION:
@@ -166,7 +184,8 @@ def decode(buf: bytes) -> Pulse:
     return Pulse(seq, t_mono_ns, t_wall_ns, store_used, store_capacity,
                  store_objects, shm_free_chunks, shm_arena_bytes,
                  num_workers, queue_depth, rss_bytes, scope_dropped,
-                 events_dropped, kinds)
+                 events_dropped, prof_oncpu_permille, prof_gil_permille,
+                 kinds)
 
 
 # --- histogram math -------------------------------------------------------
@@ -269,6 +288,8 @@ class PulseAssembler:
                  shm_arena_bytes: int = 0, num_workers: int = 0,
                  queue_depth: int = 0, rss_bytes: int = 0,
                  events_dropped: int = 0,
+                 prof_oncpu_permille: int = 0,
+                 prof_gil_permille: int = 0,
                  extra_sources: Optional[Dict[str, Tuple[dict, dict]]]
                  = None) -> Pulse:
         kinds: Dict[str, Tuple[int, int, int, Tuple[int, ...]]] = {}
@@ -291,7 +312,10 @@ class PulseAssembler:
             shm_arena_bytes=shm_arena_bytes, num_workers=num_workers,
             queue_depth=queue_depth, rss_bytes=rss_bytes,
             scope_dropped=graftscope.dropped(),
-            events_dropped=events_dropped, kinds=kinds)
+            events_dropped=events_dropped,
+            prof_oncpu_permille=min(int(prof_oncpu_permille), 1000),
+            prof_gil_permille=min(int(prof_gil_permille), 1000),
+            kinds=kinds)
 
 
 # --- controller-side time series + aggregation ----------------------------
@@ -381,6 +405,8 @@ class ClusterAggregator:
                     "rss_bytes": last.rss_bytes,
                     "shm_free_chunks": last.shm_free_chunks,
                     "shm_arena_bytes": last.shm_arena_bytes,
+                    "prof_oncpu_permille": last.prof_oncpu_permille,
+                    "prof_gil_permille": last.prof_gil_permille,
                 }
             if len(w) >= 2:
                 span_s = max(span_s,

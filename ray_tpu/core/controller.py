@@ -145,6 +145,14 @@ class Controller:
         from ray_tpu.core._native.grafttrail import TrailLedger
         self.trail = TrailLedger(GlobalConfig.trail_task_cap,
                                  GlobalConfig.trail_object_cap)
+        # graftprof: bounded per-node/per-task profile store. Agents
+        # forward their workers' folded-stack deltas fire-and-forget
+        # (report_prof_batch); merges are add-only so a lost batch
+        # loses a window, never corrupts a fold.
+        from ray_tpu.core._native.graftprof import ProfStore
+        self.prof = ProfStore(history=GlobalConfig.prof_history,
+                              task_cap=GlobalConfig.prof_task_cap,
+                              stack_cap=GlobalConfig.prof_stack_cap)
         # Infeasible-demand signals, coalesced BY SHAPE (a parked lease
         # retries pick_node every ~250ms; raw per-attempt records would
         # multiply one pending task into dozens of demands and stampede
@@ -495,6 +503,45 @@ class Controller:
         return self.trail.audit(alive, residents=residents,
                                 grace_s=grace_s)
 
+    # -- graftprof (the `ray_tpu prof` + /api/prof backends) ----------
+    async def report_prof_batch(self, node_id: bytes, payloads: list
+                                ) -> None:
+        """graftprof ingest: one fire-and-forget batch per node per
+        flush tick — each payload is one process's folded-stack delta
+        for its last ~2s window. Malformed payloads are dropped."""
+        hex_id = node_id.hex()[:12]
+        for payload in payloads:
+            try:
+                self.prof.ingest(hex_id, payload)
+            except Exception:
+                continue
+
+    async def prof_top(self, task=None, actor=None, node=None,
+                       seconds=None, limit: int = 30) -> dict:
+        return self.prof.top(task=task or "", actor=actor or "",
+                             node=node or "",
+                             seconds=float(seconds or 0.0), limit=limit)
+
+    async def prof_flame(self, task=None, actor=None, node=None,
+                         seconds=None) -> dict:
+        return self.prof.flame(task=task or "", actor=actor or "",
+                               node=node or "",
+                               seconds=float(seconds or 0.0))
+
+    async def prof_collapsed(self, task=None, actor=None, node=None,
+                             seconds=None) -> list:
+        return self.prof.collapsed(task=task or "", actor=actor or "",
+                                   node=node or "",
+                                   seconds=float(seconds or 0.0))
+
+    async def prof_task_stats(self, task_id: str):
+        """On-CPU / GIL-wait accounting for one task id (prefix ok) —
+        the `ray_tpu get task` join against the trail ledger."""
+        return self.prof.task_stats(task_id)
+
+    async def prof_stats(self) -> dict:
+        return self.prof.stats()
+
     async def report_native_spans(self, spans: list) -> None:
         """graftscope spans from worker flushers / agent metric ticks.
         Put-side spans teach us oid64 -> trace context; sidecar-side
@@ -665,6 +712,7 @@ class Controller:
         node.state = NodeState.DEAD
         self.node_metrics.pop(node_id.hex()[:12], None)  # stop reporting it
         self.pulse.forget(node_id.hex()[:12])
+        self.prof.forget_node(node_id.hex()[:12])
         # Conservation fold: attempts open on the node fail with node-
         # death provenance, live objects homed there are freed — the
         # audit after a SIGKILL chaos pass must balance to zero.

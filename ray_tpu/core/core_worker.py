@@ -414,8 +414,14 @@ class CoreWorker:
         # flag resolves override > RAY_TPU_GRAFTSCOPE env > default(on),
         # mirroring the C side's lazy getenv — this call only matters
         # for programmatic initialize() overrides.
-        from ray_tpu.core._native import graftscope
+        from ray_tpu.core._native import graftprof, graftscope
         graftscope.configure_from_flags()
+        # Continuous profiling: both graftprof samplers (native CPU/GIL
+        # + Python wall-stack) run for the life of the process; profile
+        # deltas ride the same 2 s flush tick below.
+        graftprof.configure_from_flags()
+        if graftprof.enabled():
+            graftprof.start()
         spawn(self._task_event_flusher())
         if self.mode == "driver" and GlobalConfig.log_to_driver:
             # Worker prints stream to this driver (reference:
@@ -430,15 +436,28 @@ class CoreWorker:
                 self.controller, "log_events", _print_log,
                 from_latest=True).start()
 
-    async def worker_stacks(self) -> Dict[str, str]:
+    async def worker_stacks(self, profile_s: float = 0.0) -> Dict:
         """Python stacks of every thread in this process (the `ray stack`
         analogue's fast path, reference: scripts.py:2706 — py-spy dump).
         Served from the IO loop, so a task wedged on its EXEC thread
         still answers; a wedged io loop falls back to the agent's
-        SIGUSR1/faulthandler path."""
+        SIGUSR1/faulthandler path.
+
+        With profile_s > 0 (`ray_tpu stack --profile N`), returns N
+        seconds of graftprof folded samples instead of one snapshot —
+        ``capture_stacks`` runs in the exec pool so the io loop keeps
+        serving — plus the native sidecar-thread CPU table."""
         import sys
         import threading
         import traceback
+        if profile_s and profile_s > 0:
+            from ray_tpu.core._native import graftprof
+            loop = asyncio.get_running_loop()
+            folded = await loop.run_in_executor(
+                None, graftprof.capture_stacks, min(float(profile_s), 30.0))
+            folded["thread_cpu_ns"] = list(zip(
+                graftprof.thread_names(), graftprof.thread_cpu_ns()))
+            return folded
         names = {t.ident: t.name for t in threading.enumerate()}
         out = {}
         for tid, frame in sys._current_frames().items():
@@ -605,6 +624,36 @@ class CoreWorker:
             await asyncio.sleep(2.0)
             self._flush_task_events()
             self._flush_native_spans()
+            self._flush_prof()
+
+    def _flush_prof(self) -> None:
+        """Ship this window's graftprof delta one hop to the node agent
+        (which batches every hosted worker's profile into its
+        fire-and-forget controller forward — the grafttrail transport
+        shape). Agent-less processes report straight to the
+        controller."""
+        from ray_tpu.core._native import graftprof
+        if not graftprof.enabled():
+            return
+        try:
+            payload = graftprof.collect_flush()
+        except Exception:
+            return
+        if payload is None:
+            return
+        self._spawn(self._send_prof(payload))
+
+    async def _send_prof(self, payload: dict) -> None:
+        try:
+            agent = getattr(self, "agent", None)
+            if agent is not None:
+                await agent.call("report_prof",
+                                 self.worker_id.binary(), payload)
+            else:
+                await self.controller.call("report_prof_batch", "",
+                                           [payload])
+        except Exception:
+            pass  # observability is best-effort
 
     # ------------------------------------------------------------------
     # graftscope stitching (owner-side; the native recorder's records
@@ -3817,9 +3866,18 @@ class CoreWorker:
             # flag is re-checked here too — a cancel can land while the
             # task is parked in the exec pool behind another task.
             def fn():
+                from ray_tpu.core._native import graftprof
                 self._exec_threads[spec.task_id] = threading.get_ident()
                 _trace_local.ctx = (spec.trace_id or spec.task_id,
                                     spec.task_id)
+                # Profiler attribution: register this exec thread for
+                # native CPU sampling (idempotent) and tag its wall
+                # stacks with the running task until the finally.
+                graftprof.register_current_thread("py-exec")
+                graftprof.set_task_context(
+                    spec.task_id.hex(),
+                    spec.actor_id.hex()[:12] if spec.actor_id else "",
+                    spec.name)
                 try:
                     if spec.task_id in self._exec_cancelled:
                         from ray_tpu.core.common import TaskCancelledError
@@ -3827,6 +3885,7 @@ class CoreWorker:
                             f"task {spec.name} cancelled")
                     return user_fn()
                 finally:
+                    graftprof.clear_task_context()
                     _trace_local.ctx = None
                     self._exec_threads.pop(spec.task_id, None)
 
@@ -3835,11 +3894,21 @@ class CoreWorker:
             if async_method is not None:
                 # Async actor method: runs on the io loop, concurrent with
                 # other async methods (no exec-pool hop, no ordering).
+                # Profiler attribution tags the LOOP thread: concurrent
+                # async methods time-share it, so their samples split by
+                # whichever was registered last — exact for the common
+                # one-method-at-a-time actor, approximate under overlap.
+                from ray_tpu.core._native import graftprof
                 tok = _trace_ctxvar.set(
                     (spec.trace_id or spec.task_id, spec.task_id))
+                graftprof.set_task_context(
+                    spec.task_id.hex(),
+                    spec.actor_id.hex()[:12] if spec.actor_id else "",
+                    spec.name)
                 try:
                     result = await async_method(*args, **kwargs)
                 finally:
+                    graftprof.clear_task_context()
                     _trace_ctxvar.reset(tok)
             else:
                 result = await loop.run_in_executor(self._exec_pool, fn)
@@ -3926,9 +3995,15 @@ class CoreWorker:
 
         def run_gen() -> int:
             from collections import deque
+            from ray_tpu.core._native import graftprof
             self._exec_threads[spec.task_id] = threading.get_ident()
             _trace_local.ctx = (spec.trace_id or spec.task_id,
                                 spec.task_id)
+            graftprof.register_current_thread("py-exec")
+            graftprof.set_task_context(
+                spec.task_id.hex(),
+                spec.actor_id.hex()[:12] if spec.actor_id else "",
+                spec.name)
             try:
                 if spec.task_id in self._exec_cancelled:
                     raise TaskCancelledError(f"task {spec.name} cancelled")
@@ -3961,6 +4036,7 @@ class CoreWorker:
                     pending.popleft().result()
                 return count
             finally:
+                graftprof.clear_task_context()
                 _trace_local.ctx = None
                 self._exec_threads.pop(spec.task_id, None)
 

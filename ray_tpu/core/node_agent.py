@@ -232,6 +232,12 @@ class NodeAgent:
         self._trail_objects: List[tuple] = []
         self._trail_cap = 20000
         self._trail_on = False  # set from config in start()
+        # graftprof: hosted workers hand their profile deltas over one
+        # local hop (report_prof); a flush tick forwards the node batch
+        # to the controller fire-and-forget. The rolling window feeds
+        # the pulse's on-CPU%/GIL% gauges.
+        self._prof_buf: List[dict] = []
+        self._prof_window: List[tuple] = []  # (rx_s, wall, oncpu, gil)
         self._node_hex = self.node_id.hex()[:12]
         self._shutdown = False
 
@@ -290,6 +296,15 @@ class NodeAgent:
         self._trail_on = grafttrail.enabled()
         if self._trail_on:
             spawn(self._trail_loop())
+        # graftprof in the agent process: the native sampler covers the
+        # sidecar threads (reactor, store conn/accept, copy workers,
+        # reaper) that registered at thread birth; worker profile deltas
+        # are forwarded by _prof_loop.
+        from ray_tpu.core._native import graftprof
+        graftprof.configure_from_flags()
+        if graftprof.enabled():
+            graftprof.start()
+            spawn(self._prof_loop())
         if GlobalConfig.memory_monitor_refresh_ms > 0:
             spawn(self._memory_monitor_loop())
         if GlobalConfig.worker_prestart > 0:
@@ -482,6 +497,7 @@ class NodeAgent:
                     if wid in self.workers}
                 extra = {"w:" + wid.hex()[:12]: blocks
                          for wid, blocks in self._worker_scope.items()}
+                oncpu_pm, gil_pm = self._prof_permille()
                 pulse = asm.assemble(
                     extra_sources=extra,
                     store_used=self.store.used(),
@@ -493,7 +509,9 @@ class NodeAgent:
                     queue_depth=len(self.leases)
                     + len(self._lease_waiters),
                     rss_bytes=rss,
-                    events_dropped=E.dropped_total())
+                    events_dropped=E.dropped_total(),
+                    prof_oncpu_permille=oncpu_pm,
+                    prof_gil_permille=gil_pm)
                 await asyncio.wait_for(
                     self.controller.call(
                         "report_pulse", self.node_id.binary(),
@@ -957,6 +975,62 @@ class NodeAgent:
         ops and nothing else."""
         if worker_id in self.workers:
             self._worker_scope[worker_id] = (counters, hists)
+
+    async def report_prof(self, worker_id: bytes, payload: dict) -> None:
+        """graftprof: one hosted worker's profile delta for the last
+        flush window. Buffered for the fire-and-forget controller
+        forward; the wall/on-CPU/GIL totals also feed the node pulse's
+        hot-node gauges."""
+        if worker_id not in self.workers or not isinstance(payload, dict):
+            return
+        self._prof_buf.append(payload)
+        if len(self._prof_buf) > 256:  # forward-loop outage bound
+            del self._prof_buf[:128]
+        self._prof_window.append((time.time(),
+                                  int(payload.get("wall_ns") or 0),
+                                  int(payload.get("oncpu_ns") or 0),
+                                  int(payload.get("gil_ns") or 0)))
+
+    def _prof_permille(self, horizon_s: float = 6.0) -> Tuple[int, int]:
+        """Worker on-CPU and GIL-wait shares (permille of summed worker
+        wall time) over the recent report window — the pulse gauges."""
+        cutoff = time.time() - horizon_s
+        self._prof_window = [w for w in self._prof_window
+                             if w[0] >= cutoff]
+        wall = sum(w[1] for w in self._prof_window)
+        if wall <= 0:
+            return 0, 0
+        oncpu = sum(w[2] for w in self._prof_window)
+        gil = sum(w[3] for w in self._prof_window)
+        return (min(1000, oncpu * 1000 // wall),
+                min(1000, gil * 1000 // wall))
+
+    async def _prof_loop(self) -> None:
+        """Forward buffered worker profile deltas to the controller
+        (fire-and-forget, the grafttrail transport shape). The agent's
+        own process ships a delta too so sidecar-thread CPU shows up in
+        `prof top`."""
+        from ray_tpu.core._native import graftprof
+        while not self._shutdown:
+            await asyncio.sleep(2.0)
+            try:
+                own = graftprof.collect_flush()
+            except Exception:
+                own = None
+            if own is not None:
+                self._prof_buf.append(own)
+            if not self._prof_buf:
+                continue
+            batch, self._prof_buf = self._prof_buf, []
+            try:
+                await asyncio.wait_for(
+                    self.controller.call("report_prof_batch",
+                                         self.node_id.binary(), batch),
+                    timeout=2.0)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.debug("prof forward failed: %r", e)
 
     async def _prestart_workers(self, n: int) -> None:
         """Warm the pool at startup (reference: worker_pool.cc
@@ -1849,14 +1923,20 @@ class NodeAgent:
                 except Exception:
                     pass
 
-    async def dump_stacks(self) -> dict:
+    async def dump_stacks(self, profile_s: float = 0.0) -> dict:
         """Python stacks of every live worker on this node (reference:
         `ray stack`, scripts.py:2706). Fast path: the worker's own
         worker_stacks RPC (io loop alive). Fallback for a WEDGED worker:
         SIGUSR1 triggers its faulthandler dump to
         <session>/stacks/<pid>.txt, which we read back — that path works
-        as long as the process can run signal handlers."""
+        as long as the process can run signal handlers.
+
+        profile_s > 0 switches the RPC path from a single snapshot to a
+        graftprof fold over that many seconds (`ray_tpu stack
+        --profile N`); the signal fallback stays a snapshot."""
         import signal
+        profile_s = min(max(0.0, float(profile_s or 0.0)), 30.0)
+        rpc_timeout = 2.0 + profile_s
         out: dict = {}
         for w in list(self.workers.values()):
             if not isinstance(w.proc, subprocess.Popen) \
@@ -1870,7 +1950,8 @@ class NodeAgent:
             if w.client is not None:
                 try:
                     stacks = await asyncio.wait_for(
-                        w.client.call("worker_stacks"), timeout=2.0)
+                        w.client.call("worker_stacks", profile_s),
+                        timeout=rpc_timeout)
                     entry["via"] = "rpc"
                 except Exception as e:
                     entry["rpc_error"] = repr(e)  # kept for diagnosis

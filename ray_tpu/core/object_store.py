@@ -153,6 +153,34 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
     lib.scope_dropped.restype = ctypes.c_uint64
     lib.scope_dropped.argtypes = []
+    # graftprof continuous profiler (prof_core.cc).
+    lib.prof_register_thread.restype = ctypes.c_int
+    lib.prof_register_thread.argtypes = [ctypes.c_char_p]
+    lib.prof_set_gil_fns.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.prof_start.restype = ctypes.c_int
+    lib.prof_start.argtypes = [ctypes.c_int]
+    lib.prof_stop.argtypes = []
+    lib.prof_enabled.restype = ctypes.c_int
+    lib.prof_enabled.argtypes = []
+    lib.prof_set_enabled.argtypes = [ctypes.c_int]
+    lib.prof_drain.restype = ctypes.c_int
+    lib.prof_drain.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.prof_dropped.restype = ctypes.c_uint64
+    lib.prof_dropped.argtypes = []
+    lib.prof_ticks.restype = ctypes.c_uint64
+    lib.prof_ticks.argtypes = []
+    lib.prof_thread_count.restype = ctypes.c_int
+    lib.prof_thread_count.argtypes = []
+    lib.prof_thread_cpu_ns.restype = ctypes.c_int
+    lib.prof_thread_cpu_ns.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+    lib.prof_thread_name.restype = ctypes.c_int
+    lib.prof_thread_name.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.prof_gil_wait_ns.restype = ctypes.c_uint64
+    lib.prof_gil_wait_ns.argtypes = []
+    lib.prof_gil_probes.restype = ctypes.c_uint64
+    lib.prof_gil_probes.argtypes = []
     return lib
 
 

@@ -17,6 +17,10 @@ React client is out of scope). Endpoints:
     GET /api/native      -> native hot-path latency rollup (graftscope)
     GET /api/cluster     -> graftpulse SLO view (per-op p50/p99, per-node
                             occupancy + pulse health, resident totals)
+    GET /api/prof?view=top|flame|collapsed|stats&task=&actor=&node=
+                 &seconds=&limit=
+                         -> graftprof continuous-profiling queries
+    GET /flame           -> self-contained flamegraph view over /api/prof
     GET /metrics         -> Prometheus text exposition
     GET /metrics/cluster -> federated exposition + raytpu_cluster_*
                             pulse aggregates
@@ -64,6 +68,7 @@ _PAGE = """<!doctype html>
 <a href="/api/tasks">tasks</a> · <a href="/api/workers">workers</a> ·
 <a href="/api/jobs">jobs</a> · <a href="/api/native">native</a> ·
 <a href="/api/cluster">cluster</a> ·
+<a href="/api/prof?view=top">prof</a> · <a href="/flame">flame</a> ·
 <a href="/api/timeline">timeline</a> · <a href="/metrics">metrics</a> ·
 <a href="/metrics/cluster">metrics/cluster</a></p>
 <script>
@@ -142,6 +147,90 @@ async function tick() {
 tick(); setInterval(tick, 2000);
 </script></body></html>"""
 
+# Self-contained flamegraph over /api/prof?view=flame — nested-div icicle
+# layout from the d3-flamegraph-shaped JSON, zero external assets so it
+# renders on an air-gapped cluster.
+_FLAME_PAGE = """<!doctype html>
+<html><head><title>ray_tpu flamegraph</title><meta charset="utf-8">
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.2em;color:#1a1a1a}
+ #controls{margin-bottom:.8em;font-size:13px}
+ #controls input{font-family:ui-monospace,monospace;font-size:12px;
+   margin-right:.6em;padding:2px 4px;border:1px solid #ccc;
+   border-radius:3px}
+ #graph{font-size:11px;font-family:ui-monospace,monospace}
+ .fr{box-sizing:border-box;height:17px;overflow:hidden;
+   white-space:nowrap;border:1px solid #fff;border-radius:2px;
+   padding:1px 3px;cursor:default;position:absolute}
+ .fr:hover{border-color:#333}
+ #graph{position:relative}
+ #detail{margin-top:.6em;font-size:12px;color:#555;
+   font-family:ui-monospace,monospace}
+ .muted{color:#888}
+ a{color:#36c;text-decoration:none}
+</style></head><body>
+<h2>graftprof flamegraph</h2>
+<div id="controls">
+ task <input id="task" size=18 placeholder="id prefix or name">
+ actor <input id="actor" size=10> node <input id="node" size=10>
+ seconds <input id="seconds" size=5>
+ <button onclick="draw()">refresh</button>
+ <span class=muted>(<a href="/">overview</a> ·
+ <a href="/api/prof?view=top">top json</a>)</span>
+</div>
+<div id="graph"></div><div id="detail" class=muted></div>
+<script>
+function color(name) {
+  let h = 0;
+  for (const ch of name) h = (h * 31 + ch.charCodeAt(0)) >>> 0;
+  return `hsl(${20 + h % 40},${60 + h % 30}%,${62 + h % 12}%)`;
+}
+function layout(node, x, w, depth, out, total) {
+  out.push({node, x, w, depth});
+  let cx = x;
+  for (const c of node.children || []) {
+    const cw = w * c.value / node.value;
+    layout(c, cx, cw, depth + 1, out, total);
+    cx += cw;
+  }
+  return out;
+}
+async function draw() {
+  const q = new URLSearchParams({view: "flame"});
+  for (const k of ["task","actor","node","seconds"]) {
+    const v = document.getElementById(k).value.trim();
+    if (v) q.set(k, v);
+  }
+  const root = await fetch("/api/prof?" + q).then(r => r.json());
+  const g = document.getElementById("graph");
+  if (!root.value) {
+    g.innerHTML = "<span class=muted>no samples matched</span>";
+    return;
+  }
+  const W = g.clientWidth || 960;
+  const rows = layout(root, 0, W, 0, [], root.value);
+  const maxd = Math.max(...rows.map(r => r.depth));
+  g.style.height = (maxd + 1) * 17 + "px";
+  g.innerHTML = "";
+  for (const r of rows) {
+    if (r.w < 1) continue;
+    const d = document.createElement("div");
+    d.className = "fr";
+    d.style.left = r.x + "px";
+    d.style.top = r.depth * 17 + "px";
+    d.style.width = Math.max(1, r.w - 1) + "px";
+    d.style.background = color(r.node.name);
+    d.textContent = r.node.name;
+    const pct = (100 * r.node.value / root.value).toFixed(1);
+    d.title = `${r.node.name} — ${r.node.value} samples (${pct}%)`;
+    d.onmouseenter = () => document.getElementById("detail")
+        .textContent = d.title;
+    g.appendChild(d);
+  }
+}
+draw();
+</script></body></html>"""
+
 
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # quiet
@@ -191,6 +280,28 @@ class _Handler(BaseHTTPRequestHandler):
                     live=(None if live is None else live == "1"),
                     limit=int(q.get("limit", 100))),
                     default=str).encode())
+                return
+            if path == "/flame":
+                self._send(200, _FLAME_PAGE.encode(), "text/html")
+                return
+            if path == "/api/prof":
+                # graftprof: profiles already live on the controller —
+                # the query is a pure read, no attach step.
+                view = q.get("view", "top")
+                filt = dict(task=q.get("task"), actor=q.get("actor"),
+                            node=q.get("node"),
+                            seconds=(float(q["seconds"])
+                                     if q.get("seconds") else None))
+                if view == "flame":
+                    body = state.prof_flame(**filt)
+                elif view == "collapsed":
+                    body = state.prof_collapsed(**filt)
+                elif view == "stats":
+                    body = state.prof_stats()
+                else:
+                    body = state.prof_top(
+                        limit=int(q.get("limit", 30)), **filt)
+                self._send(200, json.dumps(body, default=str).encode())
                 return
             if path == "/api/state/summary":
                 self._send(200, json.dumps(state.summary_tasks(),

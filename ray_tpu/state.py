@@ -167,14 +167,21 @@ def timeline(filename: Optional[str] = None,
     return trace
 
 
-def stack(node_id: Optional[str] = None) -> dict:
+def stack(node_id: Optional[str] = None,
+          profile_s: float = 0.0) -> dict:
     """Python stack traces of every worker on every (or one) node — the
     hung-worker debugger (reference: `ray stack`, scripts.py:2706 via
     py-spy; here the worker's own stacks RPC with a SIGUSR1/faulthandler
     fallback for wedged event loops). Returns
-    {node_id_hex: {pid: {stacks, via, worker_id, actor}}}."""
+    {node_id_hex: {pid: {stacks, via, worker_id, actor}}}.
+
+    profile_s > 0 folds that many seconds of graftprof samples per
+    worker instead of taking a single snapshot (`ray_tpu stack
+    --profile N`) and attaches per-thread native CPU times (the
+    sidecar threads included)."""
     from ray_tpu import api
     cw = api._cw()
+    profile_s = min(max(0.0, float(profile_s or 0.0)), 30.0)
     out = {}
     for n in list_nodes():
         nid = n["node_id"]
@@ -185,7 +192,53 @@ def stack(node_id: Optional[str] = None) -> dict:
         host, port = n["addr"].rsplit(":", 1)
         try:
             agent = cw._client_for_worker((host, int(port)))
-            out[nid] = cw._run(agent.call("dump_stacks")).result(30)
+            out[nid] = cw._run(agent.call(
+                "dump_stacks", profile_s)).result(30 + profile_s)
         except Exception as e:
             out[nid] = {"error": repr(e)}
     return out
+
+
+# ---------------------------------------------------------------------------
+# graftprof (continuous profiling)
+# ---------------------------------------------------------------------------
+
+def prof_top(task: Optional[str] = None, actor: Optional[str] = None,
+             node: Optional[str] = None, seconds: Optional[float] = None,
+             limit: int = 30) -> dict:
+    """Hottest frames from the always-on graftprof plane: per frame,
+    self samples (leaf) and cumulative samples (anywhere on stack).
+    Filters: task id prefix OR exact task name, actor id prefix, node
+    hex12; `seconds` restricts to recent windows instead of the merged
+    per-task folds (reference contrast: Ray attaches py-spy on demand;
+    here profiles are already on the controller)."""
+    return _ctl("prof_top", task, actor, node, seconds, limit)
+
+
+def prof_flame(task: Optional[str] = None, actor: Optional[str] = None,
+               node: Optional[str] = None,
+               seconds: Optional[float] = None) -> dict:
+    """d3-flamegraph nested JSON ({name, value, children}) for the
+    selected profiles (same filters as prof_top)."""
+    return _ctl("prof_flame", task, actor, node, seconds)
+
+
+def prof_collapsed(task: Optional[str] = None,
+                   actor: Optional[str] = None,
+                   node: Optional[str] = None,
+                   seconds: Optional[float] = None) -> List[str]:
+    """Brendan-Gregg collapsed stacks ("a;b;c N" lines) — feed to any
+    external flamegraph.pl-compatible tool."""
+    return _ctl("prof_collapsed", task, actor, node, seconds)
+
+
+def prof_task_stats(task_id: str) -> Optional[dict]:
+    """One task's profile accounting: samples, on-CPU ns, GIL-wait ns
+    (the `ray_tpu get task` join). Accepts a task-id hex prefix."""
+    return _ctl("prof_task_stats", task_id)
+
+
+def prof_stats() -> dict:
+    """ProfStore occupancy: nodes, tracked tasks, total samples,
+    drops reported by worker rings."""
+    return _ctl("prof_stats")

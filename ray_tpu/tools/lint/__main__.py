@@ -72,6 +72,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--pulse-cc", default=None,
                     help="C side of the graftpulse record schema "
                          "(default: csrc/scope_core.h)")
+    ap.add_argument("--prof-py", default=None,
+                    help="Python side of the graftprof record schema "
+                         "(default: ray_tpu/core/_native/graftprof.py)")
+    ap.add_argument("--prof-cc", default=None,
+                    help="C side of the graftprof record schema "
+                         "(default: csrc/prof_core.h)")
     ap.add_argument("--rpc-root", default=None,
                     help="root scanned for RPC call sites/handlers "
                          "(default: ray_tpu/); 'none' disables")
@@ -189,13 +195,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings.append(Finding(
                 "<wire>", 1, wire_schema.RULE, "error",
                 f"graftpulse schema sources missing: {p_py} / {p_cc}"))
+        # Pass 3g: graftprof sample record schema.
+        pr_py = args.prof_py or os.path.join(
+            root, "ray_tpu", "core", "_native", "graftprof.py")
+        pr_cc = args.prof_cc or os.path.join(root, "csrc", "prof_core.h")
+        if os.path.exists(pr_py) and os.path.exists(pr_cc):
+            findings += wire_schema.run_prof(
+                pr_py, pr_cc,
+                os.path.relpath(pr_py, root).replace(os.sep, "/"),
+                os.path.relpath(pr_cc, root).replace(os.sep, "/"))
+        elif args.prof_py or args.prof_cc or not explicit_paths:
+            findings.append(Finding(
+                "<wire>", 1, wire_schema.RULE, "error",
+                f"graftprof schema sources missing: {pr_py} / {pr_cc}"))
         # Pass 3d: ctypes binding signatures vs the C exports of every
         # translation unit in the shared library.
         ct_py = args.store_py or os.path.join(
             root, "ray_tpu", "core", "object_store.py")
         ct_ccs = [os.path.join(root, "csrc", f)
                   for f in ("object_store.cc", "store_server.cc",
-                            "copy_core.cc", "scope_core.cc")]
+                            "copy_core.cc", "scope_core.cc",
+                            "prof_core.cc")]
         ct_ccs_found = [p for p in ct_ccs if os.path.exists(p)]
         if os.path.exists(ct_py) and ct_ccs_found:
             findings += wire_schema.run_ctypes(

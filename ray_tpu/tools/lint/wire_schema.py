@@ -1075,6 +1075,7 @@ class PulsePySchema:
         self.version: Optional[int] = None
         self.hist_buckets: Optional[int] = None
         self.hist_shift: Optional[int] = None
+        self.version_sizes: Dict[int, int] = {}      # PULSE_VERSION_SIZES
 
 
 def parse_pulse_py(path: str) -> Tuple[PulsePySchema, List[str]]:
@@ -1124,6 +1125,16 @@ def parse_pulse_py(path: str) -> Tuple[PulsePySchema, List[str]]:
                         schema.struct_widths.append(w)
             else:
                 errors.append("PULSE_RECORD is not struct.Struct(<literal>)")
+        elif name == "PULSE_VERSION_SIZES":
+            if not isinstance(val, ast.Dict):
+                errors.append("PULSE_VERSION_SIZES is not a dict literal")
+                continue
+            for k, v in zip(val.keys, val.values):
+                kv, vv = _const_int(k), _const_int(v)
+                if kv is None or vv is None:
+                    errors.append("PULSE_VERSION_SIZES: bad entry")
+                else:
+                    schema.version_sizes[kv] = vv
     if not schema.record_fields:
         errors.append("PULSE_RECORD_FIELDS not found")
     if not schema.struct_widths:
@@ -1139,6 +1150,7 @@ class PulseCSchema:
         self.version: Optional[int] = None
         self.hist_buckets: Optional[int] = None
         self.hist_shift: Optional[int] = None
+        self.version_sizes: Dict[int, int] = {}      # kPulseVersionSizes
 
 
 def parse_pulse_c(path: str) -> Tuple[PulseCSchema, List[str]]:
@@ -1158,6 +1170,14 @@ def parse_pulse_c(path: str) -> Tuple[PulseCSchema, List[str]]:
             setattr(schema, attr, int(m.group(1), 0))
         else:
             errors.append(f"{cname} constexpr not found")
+
+    m = re.search(r"kPulseVersionSizes\[\]\[2\]\s*=\s*\{(.*?)\};",
+                  text, re.S)
+    if m:
+        for rm in re.finditer(r"\{\s*(\d+)\s*,\s*(\d+)\s*\}", m.group(1)):
+            schema.version_sizes[int(rm.group(1))] = int(rm.group(2))
+    else:
+        errors.append("kPulseVersionSizes registry not found")
 
     m = re.search(r"struct\s+PulseWireRec\s*\{(.*?)\};", text, re.S)
     if not m:
@@ -1238,4 +1258,269 @@ def run_pulse(py_path: str, cc_path: str, py_rel: str, cc_rel: str
         if pv is not None and cv is not None and pv != cv:
             err(py_rel, f"pulse {label} drift: Python {pv} vs "
                         f"C {cname}={cv}")
+
+    # 5. Version -> size registries: identical on both sides, and the
+    #    CURRENT version's registered size must equal the record size —
+    #    widening the header without bumping the version (or without
+    #    appending a registry row) is exactly the silent drift this
+    #    registry exists to catch.
+    if py.version_sizes != cc.version_sizes:
+        err(py_rel, f"pulse version registry drift: Python "
+                    f"PULSE_VERSION_SIZES={py.version_sizes} vs C "
+                    f"kPulseVersionSizes={cc.version_sizes}")
+    if py.version is not None and py.version_sizes:
+        reg = py.version_sizes.get(py.version)
+        if reg is None:
+            err(py_rel, f"PULSE_VERSION={py.version} has no entry in "
+                        f"PULSE_VERSION_SIZES — append one per wire "
+                        f"revision")
+        elif py.record_size is not None and reg != py.record_size:
+            err(py_rel, f"pulse header widened without a version bump: "
+                        f"PULSE_RECORD_SIZE={py.record_size} but "
+                        f"PULSE_VERSION_SIZES[{py.version}]={reg}")
+    if cc.version is not None and cc.version_sizes:
+        reg = cc.version_sizes.get(cc.version)
+        if reg is None:
+            err(cc_rel, f"kPulseVersion={cc.version} has no entry in "
+                        f"kPulseVersionSizes — append one per wire "
+                        f"revision")
+        elif cc.record_size is not None and reg != cc.record_size:
+            err(cc_rel, f"pulse header widened without a version bump: "
+                        f"kPulseRecordSize={cc.record_size} but "
+                        f"kPulseVersionSizes[{cc.version}]={reg}")
+    return findings
+
+
+# ==========================================================================
+# Pass 3g — graftprof sample-record drift.
+#
+# The 24-byte profiler sample record is hand-duplicated: kind numbers,
+# field layout and the ring geometry live in
+# `ray_tpu/core/_native/graftprof.py` (PROF_TICK/.../PROF_KIND_COUNT,
+# PROF_RECORD_FIELDS, PROF_RECORD struct format, PROF_RECORD_SIZE,
+# PROF_DEFAULT_HZ/MAX_THREADS/RING_CAP/NAME_CAP) and again in
+# `csrc/prof_core.h` (kProf* kind constants, packed struct ProfWireRec,
+# kProfRecordSize, the kProf* geometry constexprs). Drift corrupts
+# every decoded sample silently (records still parse — into garbage
+# CPU/GIL attribution) or desyncs the drain stride, so re-derive both
+# sides and fail on any mismatch: kind name/value, field
+# name/width/order, record size, geometry scalar.
+# ==========================================================================
+
+# C geometry constant -> Python name; everything else matching kProf*
+# is a record kind.
+_PROF_GEOMETRY = {
+    "DefaultHz": "PROF_DEFAULT_HZ",
+    "MaxThreads": "PROF_MAX_THREADS",
+    "RingCap": "PROF_RING_CAP",
+    "NameCap": "PROF_NAME_CAP",
+}
+
+
+def _prof_py_name(c_kind: str) -> str:
+    """kProfThreadCpu -> PROF_THREAD_CPU; kProfKindCount ->
+    PROF_KIND_COUNT."""
+    return "PROF_" + _camel_to_upper_snake(c_kind)
+
+
+class ProfPySchema:
+    def __init__(self) -> None:
+        self.kinds: Dict[str, int] = {}          # PROF_THREAD_CPU -> 2
+        self.record_fields: List[Tuple[str, int]] = []
+        self.struct_widths: List[int] = []       # from "<BBHIQQ"
+        self.record_size: Optional[int] = None
+        self.geometry: Dict[str, int] = {}       # PROF_RING_CAP -> 4096
+
+
+def parse_prof_py(path: str) -> Tuple[ProfPySchema, List[str]]:
+    errors: List[str] = []
+    schema = ProfPySchema()
+    geometry_names = set(_PROF_GEOMETRY.values())
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1 \
+                or not isinstance(stmt.targets[0], ast.Name):
+            continue
+        name, val = stmt.targets[0].id, stmt.value
+        if name == "PROF_RECORD_FIELDS":
+            if not isinstance(val, ast.Tuple):
+                errors.append("PROF_RECORD_FIELDS is not a tuple")
+                continue
+            for el in val.elts:
+                if (isinstance(el, ast.Tuple) and len(el.elts) == 2
+                        and isinstance(el.elts[0], ast.Constant)):
+                    w = _const_int(el.elts[1])
+                    if w is None:
+                        errors.append("PROF_RECORD_FIELDS: bad width")
+                        continue
+                    schema.record_fields.append((el.elts[0].value, w))
+                else:
+                    errors.append("PROF_RECORD_FIELDS: bad entry shape")
+        elif name == "PROF_RECORD":
+            if (isinstance(val, ast.Call) and val.args
+                    and isinstance(val.args[0], ast.Constant)):
+                fmt = val.args[0].value
+                for ch in str(fmt).lstrip("<>=!@"):
+                    w = _STRUCT_CHAR_WIDTHS.get(ch)
+                    if w is None:
+                        errors.append(
+                            f"PROF_RECORD: unknown format char {ch!r}")
+                    else:
+                        schema.struct_widths.append(w)
+            else:
+                errors.append("PROF_RECORD is not struct.Struct(<literal>)")
+        elif name == "PROF_RECORD_SIZE":
+            schema.record_size = _const_int(val)
+            if schema.record_size is None:
+                errors.append("cannot evaluate PROF_RECORD_SIZE")
+        elif name in geometry_names:
+            v = _const_int(val)
+            if v is None:
+                errors.append(f"cannot evaluate {name}")
+            else:
+                schema.geometry[name] = v
+        elif name.startswith("PROF_"):
+            if isinstance(val, (ast.Dict, ast.List, ast.Set)):
+                continue  # lookup tables (PROF_KIND_NAMES), not kinds
+            v = _const_int(val)
+            if v is None:
+                errors.append(f"cannot evaluate {name}")
+            else:
+                schema.kinds[name] = v
+    if not schema.kinds:
+        errors.append("no PROF_* kind constants found")
+    if not schema.record_fields:
+        errors.append("PROF_RECORD_FIELDS not found")
+    if not schema.struct_widths:
+        errors.append("PROF_RECORD struct format not found")
+    return schema, errors
+
+
+class ProfCSchema:
+    def __init__(self) -> None:
+        self.kinds: Dict[str, int] = {}          # ThreadCpu -> 2
+        self.record_fields: List[Tuple[str, int]] = []
+        self.record_size: Optional[int] = None
+        self.geometry: Dict[str, int] = {}       # RingCap -> 4096
+
+
+def parse_prof_c(path: str) -> Tuple[ProfCSchema, List[str]]:
+    errors: List[str] = []
+    schema = ProfCSchema()
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+
+    for m in re.finditer(r"kProf([A-Za-z0-9_]+)\s*=\s*(\d+)", text):
+        name, value = m.group(1), int(m.group(2))
+        if name == "RecordSize":
+            continue  # checked via the constexpr regex below
+        if name in _PROF_GEOMETRY:
+            schema.geometry[name] = value
+        else:
+            schema.kinds[name] = value
+    if not schema.kinds:
+        errors.append("no kProf* kind constants found")
+    for cname in _PROF_GEOMETRY:
+        if cname not in schema.geometry:
+            errors.append(f"kProf{cname} constexpr not found")
+
+    m = re.search(r"constexpr\s+int\s+kProfRecordSize\s*=\s*(\d+)\s*;",
+                  text)
+    if m:
+        schema.record_size = int(m.group(1))
+    else:
+        errors.append("kProfRecordSize constexpr not found")
+
+    m = re.search(r"struct\s+ProfWireRec\s*\{(.*?)\};", text, re.S)
+    if not m:
+        errors.append("struct ProfWireRec not found")
+    else:
+        for fm in re.finditer(
+                r"^\s*([A-Za-z_][A-Za-z0-9_]*)\s+([A-Za-z_][A-Za-z0-9_]*)"
+                r"\s*;", m.group(1), re.M):
+            ctype, fname = fm.group(1), fm.group(2)
+            width = _C_TYPE_WIDTHS.get(ctype)
+            if width is None:
+                errors.append(f"struct ProfWireRec: unknown type {ctype}")
+                continue
+            schema.record_fields.append((fname, width))
+        if not schema.record_fields:
+            errors.append("struct ProfWireRec has no parsable fields")
+    return schema, errors
+
+
+def run_prof(py_path: str, cc_path: str, py_rel: str, cc_rel: str
+             ) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def err(path: str, msg: str) -> None:
+        findings.append(Finding(path, 1, RULE, "error", msg))
+
+    py, py_errors = parse_prof_py(py_path)
+    cc, cc_errors = parse_prof_c(cc_path)
+    for e in py_errors:
+        err(py_rel, e)
+    for e in cc_errors:
+        err(cc_rel, e)
+    if py_errors or cc_errors:
+        return findings
+
+    # 1. Kind tables: same names (under the mechanical rename), same
+    #    values.
+    cc_kinds = {_prof_py_name(k): v for k, v in cc.kinds.items()}
+    for name in sorted(set(py.kinds) | set(cc_kinds)):
+        if name not in py.kinds:
+            err(py_rel, f"prof kind {name!r} exists in C (kProf*) but "
+                        f"has no PROF_* constant in graftprof.py")
+        elif name not in cc_kinds:
+            err(cc_rel, f"prof kind {name!r} exists in Python (PROF_*) "
+                        f"but has no kProf* constant")
+        elif py.kinds[name] != cc_kinds[name]:
+            err(py_rel, f"prof kind {name!r} drift: Python "
+                        f"{py.kinds[name]} vs C {cc_kinds[name]}")
+
+    # 2. Record layout: field-by-field name/width/order.
+    if len(py.record_fields) != len(cc.record_fields):
+        err(py_rel, f"prof record drift: Python declares "
+                    f"{len(py.record_fields)} fields, C struct has "
+                    f"{len(cc.record_fields)}")
+    for (pn, pw), (cn, cw) in zip(py.record_fields, cc.record_fields):
+        if pn != cn:
+            err(py_rel, f"prof record field order drift: Python has "
+                        f"{pn!r} where C has {cn!r}")
+        elif pw != cw:
+            err(py_rel, f"prof record field {pn!r} width drift: Python "
+                        f"{pw} vs C {cw}")
+
+    # 3. Struct format chars vs the declared field widths.
+    declared = [w for _, w in py.record_fields]
+    if py.struct_widths != declared:
+        err(py_rel, f"PROF_RECORD format widths {py.struct_widths} != "
+                    f"PROF_RECORD_FIELDS widths {declared}")
+
+    # 4. Record size: both constants and both layouts must agree.
+    psum = sum(w for _, w in py.record_fields)
+    csum = sum(w for _, w in cc.record_fields)
+    if py.record_size is not None and psum != py.record_size:
+        err(py_rel, f"PROF_RECORD_FIELDS pack to {psum} bytes but "
+                    f"PROF_RECORD_SIZE={py.record_size}")
+    if cc.record_size is not None and csum != cc.record_size:
+        err(cc_rel, f"struct ProfWireRec packs to {csum} bytes but "
+                    f"kProfRecordSize={cc.record_size}")
+    if py.record_size is not None and cc.record_size is not None \
+            and py.record_size != cc.record_size:
+        err(py_rel, f"prof record size drift: PROF_RECORD_SIZE="
+                    f"{py.record_size} vs kProfRecordSize="
+                    f"{cc.record_size}")
+
+    # 5. Ring/sampler geometry: the drain stride, the thread table and
+    #    the name buffer are sized from these on both sides.
+    for cname, pyname in sorted(_PROF_GEOMETRY.items()):
+        pv, cv = py.geometry.get(pyname), cc.geometry.get(cname)
+        if pv is None:
+            err(py_rel, f"{pyname} not found in graftprof.py")
+        elif cv is not None and pv != cv:
+            err(py_rel, f"prof geometry drift: {pyname}={pv} vs "
+                        f"C kProf{cname}={cv}")
     return findings

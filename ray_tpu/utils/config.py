@@ -146,6 +146,11 @@ _flag("trail_task_cap", int, 20000, "Task records retained in the controller tra
 _flag("trail_object_cap", int, 50000, "Object records retained in the controller trail ledger (freed records evict first; drops are counted).")
 _flag("trail_audit_grace_s", float, 300.0, "Audit grace: a non-terminal task with no transition for this long counts as lost.")
 _flag("autoscale_p99_ms", float, 0.0, "Scale up when the cluster-wide native op p99 (from graftpulse histograms) exceeds this many milliseconds while work is queued; 0 disables the latency signal.")
+_flag("graftprof", bool, True, "Continuous profiling plane (graftprof): a native per-process sampler snapshots registered-thread CPU time and GIL-acquire latency while a Python wall-stack sampler folds task-attributed flamegraph profiles; deltas ride the worker flush tick to the controller store behind `ray_tpu prof top/flame`. RAY_TPU_GRAFTPROF=0 disables both samplers (Python seam and C sampler read the same env).")
+_flag("prof_hz", int, 67, "graftprof sampling rate (ticks/s) for both the native CPU/GIL sampler and the Python wall-stack sampler. Off-round by default so the tick train can't alias the 2 s flush or the 1 s pulse.")
+_flag("prof_history", int, 120, "Profile flush windows retained per node in the controller ProfStore (the `prof top --seconds` query window).")
+_flag("prof_task_cap", int, 512, "Distinct (task, actor) merged profiles retained in the controller ProfStore (LRU eviction).")
+_flag("prof_stack_cap", int, 256, "Distinct folded stacks retained per task profile (coldest evicted on merge).")
 
 
 class Config:

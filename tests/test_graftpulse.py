@@ -39,7 +39,8 @@ def _pulse(seq=1, t_mono_ns=1_000_000_000, queue_depth=0, kinds=None,
                     store_capacity=1 << 30, store_objects=3,
                     shm_free_chunks=7, shm_arena_bytes=1 << 20,
                     num_workers=2, rss_bytes=5 << 20, scope_dropped=0,
-                    events_dropped=0)
+                    events_dropped=0, prof_oncpu_permille=0,
+                    prof_gil_permille=0)
     defaults.update(kw)
     return graftpulse.Pulse(seq=seq, t_mono_ns=t_mono_ns,
                             queue_depth=queue_depth, kinds=kinds or {},

@@ -659,9 +659,10 @@ def test_graft_schema_detects_struct_format_mismatch(tmp_path):
 OS_CC = os.path.join(REPO, "csrc", "object_store.cc")
 COPY_CC = os.path.join(REPO, "csrc", "copy_core.cc")
 SCOPE_CORE_CC = os.path.join(REPO, "csrc", "scope_core.cc")
-CT_CCS = [OS_CC, STORE_CC, COPY_CC, SCOPE_CORE_CC]
+PROF_CORE_CC = os.path.join(REPO, "csrc", "prof_core.cc")
+CT_CCS = [OS_CC, STORE_CC, COPY_CC, SCOPE_CORE_CC, PROF_CORE_CC]
 CT_RELS = ["object_store.cc", "store_server.cc", "copy_core.cc",
-           "scope_core.cc"]
+           "scope_core.cc", "prof_core.cc"]
 
 
 def _ctypes_run(py=STORE_PY, ccs=None, rels=None):
@@ -679,7 +680,8 @@ def test_ctypes_schema_detects_arity_drift(tmp_path):
                   "const char* dst)",
                   "int copy_linkat(int src_fd, const char* dst, int flags)",
                   "copy_core.cc")
-    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, SCOPE_CORE_CC, cc])
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc, SCOPE_CORE_CC,
+                          PROF_CORE_CC])
     assert fs and all(f.rule == "wire-drift" for f in fs)
     assert any("arity" in f.message and "copy_linkat" in f.message
                for f in fs), [f.render() for f in fs]
@@ -688,7 +690,8 @@ def test_ctypes_schema_detects_arity_drift(tmp_path):
 def test_ctypes_schema_detects_arg_width_drift(tmp_path):
     cc = _mutated(tmp_path, COPY_CC, "int nsegs)", "uint64_t nsegs)",
                   "copy_core.cc")
-    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, SCOPE_CORE_CC, cc])
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc, SCOPE_CORE_CC,
+                          PROF_CORE_CC])
     assert fs and any("width" in f.message
                       and "copy_write_scatter" in f.message
                       for f in fs), [f.render() for f in fs]
@@ -697,7 +700,8 @@ def test_ctypes_schema_detects_arg_width_drift(tmp_path):
 def test_ctypes_schema_detects_restype_drift(tmp_path):
     cc = _mutated(tmp_path, COPY_CC, "int copy_engine_threads(",
                   "uint64_t copy_engine_threads(", "copy_core.cc")
-    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, SCOPE_CORE_CC, cc])
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc, SCOPE_CORE_CC,
+                          PROF_CORE_CC])
     assert fs and any("restype" in f.message
                       and "copy_engine_threads" in f.message
                       for f in fs), [f.render() for f in fs]
@@ -732,7 +736,8 @@ def test_ctypes_schema_detects_cross_file_decl_drift(tmp_path):
 def test_ctypes_schema_detects_missing_c_definition(tmp_path):
     cc = _mutated(tmp_path, COPY_CC, "int copy_linkat(",
                   "int copy_linkat_v2(", "copy_core.cc")
-    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, SCOPE_CORE_CC, cc])
+    fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc, SCOPE_CORE_CC,
+                          PROF_CORE_CC])
     assert fs and any("no C definition" in f.message
                       and "copy_linkat" in f.message
                       for f in fs), [f.render() for f in fs]
@@ -831,18 +836,40 @@ def test_pulse_schema_detects_field_order_drift(tmp_path):
 
 
 def test_pulse_schema_detects_record_size_drift(tmp_path):
-    py = _mutated(tmp_path, PULSE_PY, "PULSE_RECORD_SIZE = 96",
-                  "PULSE_RECORD_SIZE = 104", "graftpulse.py")
+    py = _mutated(tmp_path, PULSE_PY, "PULSE_RECORD_SIZE = 104",
+                  "PULSE_RECORD_SIZE = 96", "graftpulse.py")
     fs = wire_schema.run_pulse(py, PULSE_CC, "py", "cc")
     assert fs and any("size" in f.message.lower() for f in fs), \
         [f.render() for f in fs]
 
 
 def test_pulse_schema_detects_struct_format_mismatch(tmp_path):
-    py = _mutated(tmp_path, PULSE_PY, 'struct.Struct("<IHHQQQQQIIQIIQQQ")',
-                  'struct.Struct("<IHHQQQQQQQQIIQQQ")', "graftpulse.py")
+    py = _mutated(tmp_path, PULSE_PY,
+                  'struct.Struct("<IHHQQQQQIIQIIQQQII")',
+                  'struct.Struct("<IHHQQQQQIIQIIQQQQI")', "graftpulse.py")
     fs = wire_schema.run_pulse(py, PULSE_CC, "py", "cc")
     assert fs, "format/width mismatch not detected"
+
+
+def test_pulse_schema_detects_version_registry_drift(tmp_path):
+    # A registry row edited on one side only (or a size retconned) is
+    # exactly what the append-only version -> size table must catch.
+    cc = _mutated(tmp_path, PULSE_CC, "{1, 96},", "{1, 88},",
+                  "scope_core.h")
+    fs = wire_schema.run_pulse(PULSE_PY, cc, "py", "cc")
+    assert fs and any("registry" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_pulse_schema_detects_widening_without_version_bump(tmp_path):
+    # Roll the version back while the header stays 104 bytes: the
+    # registry row for the claimed version no longer matches the record
+    # size, i.e. the header was widened without a bump.
+    py = _mutated(tmp_path, PULSE_PY, "PULSE_VERSION = 2",
+                  "PULSE_VERSION = 1", "graftpulse.py")
+    fs = wire_schema.run_pulse(py, PULSE_CC, "py", "cc")
+    assert fs and any("version bump" in f.message or "version" in f.message
+                      for f in fs), [f.render() for f in fs]
 
 
 def test_pulse_schema_detects_magic_drift(tmp_path):
@@ -859,6 +886,77 @@ def test_pulse_schema_detects_hist_geometry_drift(tmp_path):
     fs = wire_schema.run_pulse(py, PULSE_CC, "py", "cc")
     assert fs and any("shift" in f.message for f in fs), \
         [f.render() for f in fs]
+
+# ---------------------------------------------------------------------------
+# pass 3g — graftprof sample record drift
+# ---------------------------------------------------------------------------
+
+PROF_PY = os.path.join(REPO, "ray_tpu", "core", "_native", "graftprof.py")
+PROF_CC = os.path.join(REPO, "csrc", "prof_core.h")
+
+
+def test_prof_schema_repo_in_sync():
+    fs = wire_schema.run_prof(PROF_PY, PROF_CC, "py", "cc")
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_prof_schema_detects_kind_value_drift(tmp_path):
+    cc = _mutated(tmp_path, PROF_CC, "kProfThreadCpu = 2",
+                  "kProfThreadCpu = 7", "prof_core.h")
+    fs = wire_schema.run_prof(PROF_PY, cc, "py", "cc")
+    assert fs and all(f.rule == "wire-drift" for f in fs)
+    assert any("THREAD_CPU" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_prof_schema_detects_missing_kind(tmp_path):
+    cc = _mutated(tmp_path, PROF_CC, "kProfGilWait = 3",
+                  "kProfGilHold = 3", "prof_core.h")
+    fs = wire_schema.run_prof(PROF_PY, cc, "py", "cc")
+    assert any("GIL_HOLD" in f.message or "GIL_WAIT" in f.message
+               for f in fs), [f.render() for f in fs]
+
+
+def test_prof_schema_detects_field_width_drift(tmp_path):
+    cc = _mutated(tmp_path, PROF_CC, "uint32_t val_us;",
+                  "uint64_t val_us;", "prof_core.h")
+    fs = wire_schema.run_prof(PROF_PY, cc, "py", "cc")
+    assert fs and any("val_us" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_prof_schema_detects_field_order_drift(tmp_path):
+    py = _mutated(tmp_path, PROF_PY, '("slot", 1),\n    ("flags", 2),',
+                  '("flags", 2),\n    ("slot", 1),', "graftprof.py")
+    fs = wire_schema.run_prof(py, PROF_CC, "py", "cc")
+    assert fs and any("order" in f.message or "slot" in f.message
+                      for f in fs), [f.render() for f in fs]
+
+
+def test_prof_schema_detects_record_size_drift(tmp_path):
+    py = _mutated(tmp_path, PROF_PY, "PROF_RECORD_SIZE = 24",
+                  "PROF_RECORD_SIZE = 32", "graftprof.py")
+    fs = wire_schema.run_prof(py, PROF_CC, "py", "cc")
+    assert fs and any("size" in f.message.lower() for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_prof_schema_detects_struct_format_mismatch(tmp_path):
+    py = _mutated(tmp_path, PROF_PY, 'struct.Struct("<BBHIQQ")',
+                  'struct.Struct("<BBHQQQ")', "graftprof.py")
+    fs = wire_schema.run_prof(py, PROF_CC, "py", "cc")
+    assert fs, "format/width mismatch not detected"
+
+
+def test_prof_schema_detects_ring_geometry_drift(tmp_path):
+    # The drain buffer is sized ring_cap * record_size on the Python
+    # side; a one-sided ring resize silently truncates every drain.
+    py = _mutated(tmp_path, PROF_PY, "PROF_RING_CAP = 4096",
+                  "PROF_RING_CAP = 2048", "graftprof.py")
+    fs = wire_schema.run_prof(py, PROF_CC, "py", "cc")
+    assert fs and any("RING_CAP" in f.message for f in fs), \
+        [f.render() for f in fs]
+
 
 # ---------------------------------------------------------------------------
 # pass 4a — store-protocol state machine vs tools/lint/protocol.json

@@ -116,10 +116,11 @@ def test_native_store_sanitizers():
                              cwd=os.path.abspath(CSRC),
                              capture_output=True, text=True, timeout=600)
         assert out.returncode == 0, (target, out.stdout + out.stderr)
-        # All five native planes run sanitized: the store sidecar
-        # suite, the graftrpc reactor suite, the graftcopy engine
-        # suite, the graftscope ring-buffer suite (whose
-        # drain-while-writing storm is the whole point of running
-        # under TSAN), AND the graftshm arena suite (concurrent
-        # acquire/recycle hammer) each print their own ALL OK.
-        assert out.stdout.count("ALL OK") >= 5, (target, out.stdout)
+        # All six native suites run sanitized: the store sidecar,
+        # the graftrpc reactor, the graftcopy engine, the graftscope
+        # ring buffers (whose drain-while-writing storm is the whole
+        # point of running under TSAN), the graftshm arena
+        # (concurrent acquire/recycle hammer), AND the graftprof
+        # sampler (drain-while-sampling + stop/start races) each
+        # print their own ALL OK.
+        assert out.stdout.count("ALL OK") >= 6, (target, out.stdout)
