@@ -21,9 +21,9 @@ import numpy as np
 import ray_tpu
 
 QUICK = "--quick" in sys.argv
-# Child of bench_scope_delta: double the best-of reps — the A/B row
+# Child of an A/B delta bench: double the best-of reps — the A/B row
 # divides two of these rates, so each arm needs a tighter minimum.
-SCOPE_CHILD = "--scope-subset" in sys.argv
+SCOPE_CHILD = "--scope-subset" in sys.argv or "--log-subset" in sys.argv
 SECONDS = 2.0 if QUICK else 5.0
 
 REF = {  # BASELINE.md (release/perf_metrics/microbenchmark.json @ 2.49.1)
@@ -229,10 +229,35 @@ def bench_n_n_actor_calls():
         ray_tpu.kill(a)
 
 
+def bench_print_burst():
+    """The graftlog-hot arm: every printed line pays the stdio tee
+    (write-through + ring emit) in the worker and rides the coalesced
+    driver pump. Lines/s, best-of like the other bursts."""
+    @ray_tpu.remote
+    def shout(n):
+        for i in range(n):
+            print("bench-print-%d" % i)
+        return n
+
+    lines = 50 if QUICK else 200
+    workers = 8
+
+    def burst():
+        ray_tpu.get([shout.remote(lines) for _ in range(workers)])
+
+    burst()
+    rate = workers * lines / _best_rep(burst, 6 if SCOPE_CHILD else 3)
+    emit("print_heavy_task_lines_per_s", rate, "lines/s")
+
+
 # The two metrics most exposed to the graftscope flight recorder: the
 # n:n burst rides the graftrpc frame path (one scope_emit per frame
 # send/recv/flush) and put_gigabytes rides the graftcopy scatter path.
 _SCOPE_METRICS = ("n_n_actor_calls_async", "single_client_put_gigabytes")
+# The graftlog-sensitive pair: the print burst pays the tee + ring
+# emit per line; the n:n burst guards the no-print dispatch path
+# against the plane's standing cost (ring mmap + agent tail tick).
+_LOG_METRICS = ("print_heavy_task_lines_per_s", "n_n_actor_calls_async")
 
 
 def _scope_subset() -> None:
@@ -248,7 +273,21 @@ def _scope_subset() -> None:
         ray_tpu.shutdown()
 
 
-def _ab_delta(env_var: str, row_prefix: str, budget_pct: float) -> None:
+def _log_subset() -> None:
+    """Child mode (--log-subset): the graftlog-sensitive benches, under
+    whatever RAY_TPU_GRAFTLOG the parent set for this process tree."""
+    os.environ.setdefault("RAY_TPU_WORKER_PRESTART", "12")
+    ray_tpu.init(resources={"CPU": 16})
+    try:
+        bench_n_n_actor_calls()
+        bench_print_burst()
+    finally:
+        ray_tpu.shutdown()
+
+
+def _ab_delta(env_var: str, row_prefix: str, budget_pct: float,
+              metrics=_SCOPE_METRICS,
+              subset_flag: str = "--scope-subset") -> None:
     """Plane-on vs plane-off A/B, each arm a fresh process tree (both
     planes live in every worker/agent/sidecar, so an env flip on a live
     cluster would only cover the driver). Emits the on/off rates and
@@ -266,7 +305,7 @@ def _ab_delta(env_var: str, row_prefix: str, budget_pct: float) -> None:
     for flag in ("1", "0", "1", "0", "1", "0"):
         env = dict(os.environ)
         env[env_var] = flag
-        cmd = [sys.executable, os.path.abspath(__file__), "--scope-subset"]
+        cmd = [sys.executable, os.path.abspath(__file__), subset_flag]
         if QUICK:
             cmd.append("--quick")
         out = subprocess.run(cmd, env=env, capture_output=True, text=True,
@@ -280,10 +319,10 @@ def _ab_delta(env_var: str, row_prefix: str, budget_pct: float) -> None:
                 row = json.loads(line)
             except ValueError:
                 continue
-            if row.get("metric") in _SCOPE_METRICS:
+            if row.get("metric") in metrics:
                 arm = rates.setdefault(row["metric"], {})
                 arm[flag] = max(arm.get(flag, 0), row["value"])
-    for metric in _SCOPE_METRICS:
+    for metric in metrics:
         on, off = rates[metric].get("1"), rates[metric].get("0")
         if not on or not off:
             continue
@@ -336,6 +375,22 @@ def bench_prof_delta() -> None:
     _ab_delta("RAY_TPU_GRAFTPROF", "graftprof", 1.0)
 
 
+def bench_log_delta() -> None:
+    """graftlog on/off — the 1% budget binds the dispatch plane: a
+    task that never prints pays nothing per call (the ring mmap at
+    worker start and the agent's bounded tail tick are the only
+    standing costs), guarded by the no-print n:n burst. The
+    print-heavy arm is adversarial by design: every line pays the
+    stdio tee plus one 256-byte record into the already-mapped
+    MAP_SHARED ring (~4us Python-side — encodes + one FFI call, no
+    syscall, no fsync; tmpfs page cache IS the durability) against a
+    ~10us buffered pipe-write baseline, so the storm row reports the
+    worst-case per-line tax of crash-persistence-at-emit-return
+    rather than fitting inside 1%; see _meta."""
+    _ab_delta("RAY_TPU_GRAFTLOG", "graftlog", 1.0,
+              metrics=_LOG_METRICS, subset_flag="--log-subset")
+
+
 def main() -> None:
     # Warm worker pool: burst benches measure dispatch, not process
     # spawning (reference ray_perf also runs against prestarted pools).
@@ -357,6 +412,7 @@ def main() -> None:
     bench_pulse_delta()
     bench_trail_delta()
     bench_prof_delta()
+    bench_log_delta()
     print(json.dumps({
         "metric": "_meta",
         "note": "python bench_core.py (make bench-core regenerates "
@@ -395,7 +451,24 @@ def main() -> None:
                 "burst, 0..2.3% on puts; off-arm best-of spread alone "
                 "is ~9% here), the residual dominated by 67 Hz native "
                 "tick + 8 Hz GIL-probe wakeup churn that a "
-                "core-starved host amplifies, not by sampling work",
+                "core-starved host amplifies, not by sampling work; "
+                "graftlog_overhead_* rows: the no-print n:n burst "
+                "holds the plane's standing cost inside this host's "
+                "noise floor (sign-unstable, -5..+6% across runs — "
+                "nothing per-call on the dispatch path); the "
+                "print-heavy arm is an adversarial pure-print storm "
+                "where every line pays the stdio tee + one durable "
+                "256B record into the mmapped ring (~4us Python-side "
+                "after hot-path flattening: cached enable flag + "
+                "registry probe + encodes + one FFI call, no syscall) "
+                "against a ~10us buffered pipe-write baseline, with "
+                "the agent's bounded ring tail (<=1024 records/ring/"
+                "tick) sharing this 1-core host — measured ~44% on "
+                "the storm (53k lines/s on vs 95k off), the price of "
+                "durability-at-emit-return that no deferred capture "
+                "pays; LogStore per-worker rate caps + dedup bound "
+                "the cluster-side cost of a sustained storm "
+                "regardless of producer volume",
         "host_cores": os.cpu_count(),
     }), flush=True)
 
@@ -403,5 +476,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--scope-subset" in sys.argv:
         _scope_subset()
+    elif "--log-subset" in sys.argv:
+        _log_subset()
     else:
         main()

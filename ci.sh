@@ -10,7 +10,8 @@ cd "$(dirname "$0")"
 echo "=== static analysis ==="
 # graftlint: event-loop safety, lock discipline, Python<->C wire-schema
 # drift (store 3a, graftrpc 3c, ctypes 3d, graftscope 3e, graftpulse 3f
-# incl. the version->size registry, graftprof 3g), RPC handler-signature
+# incl. the version->size registry, graftprof 3g, graftlog 3h incl. the
+# char[] payload widths and the ring file magic), RPC handler-signature
 # drift, task/coroutine leaks — plus the graftgate passes: store-protocol
 # state machine vs tools/lint/protocol.json (4a), csrc memory-order
 # discipline (4b), error-path fd/inode leaks (4c). First gate: nothing
@@ -37,7 +38,8 @@ echo "=== native-plane sanitizers ==="
 # make tsan / make asan via the pytest wrapper: store sidecar, graftrpc
 # reactor, graftcopy engine, graftshm arena, the graftscope ring buffers
 # (the lock-free drain-while-writing storm runs under ThreadSanitizer
-# here) and the graftprof sampler ring (drain-while-sampling).
+# here), the graftprof sampler ring (drain-while-sampling), and the
+# graftlog crash-persistent ring (3-writer emit storm vs live drain).
 RAY_TPU_SANITIZER_TESTS=1 python -m pytest \
     tests/test_native_store.py::test_native_store_sanitizers -q
 

@@ -15,6 +15,8 @@ Analogue of the reference's CLI (reference: python/ray/scripts/scripts.py
     python -m ray_tpu.cli stack --address ... [--profile N]
     python -m ray_tpu.cli prof top --address ... [--task F] [--seconds N]
     python -m ray_tpu.cli prof flame --address ... -o out.json|out.collapsed
+    python -m ray_tpu.cli logs --address ... [--task P] [--level WARNING]
+    python -m ray_tpu.cli logs --address ... --tail 50 -f
     python -m ray_tpu.cli metrics --address ...
     python -m ray_tpu.cli stop --address ...
 """
@@ -359,6 +361,76 @@ def cmd_prof(args) -> int:
     return 0
 
 
+def _parse_level(s) -> int:
+    """A logging level by number ("30") or name ("WARNING")."""
+    if not s:
+        return 0
+    import logging
+    try:
+        return int(s)
+    except ValueError:
+        lv = logging.getLevelName(str(s).upper())
+        return lv if isinstance(lv, int) else 0
+
+
+def _fmt_log_row(r: dict) -> str:
+    import logging
+    import time as _t
+    ts = _t.strftime("%H:%M:%S",
+                     _t.localtime(int(r.get("t_ns") or 0) / 1e9))
+    lvl = logging.getLevelName(int(r.get("level") or 0))
+    src = {0: "log", 1: "out", 2: "err", 3: "agt"}.get(
+        int(r.get("source") or 0), "?")
+    task = r.get("task") or ""
+    where = f"pid={r.get('pid')} node={r.get('node', '')[:8]}"
+    if task:
+        where += f" task={task[:8]}"
+    rep = f" (x{r['repeats'] + 1})" if r.get("repeats") else ""
+    sal = " [salvaged]" if r.get("salvaged") else ""
+    return f"{ts} {str(lvl)[:1]} [{src}] ({where}){sal} " \
+           f"{r.get('msg', '')}{rep}"
+
+
+def cmd_logs(args) -> int:
+    """The graftlog surface: time-ordered cluster log records from the
+    controller LogStore — every worker's logger calls and captured
+    stdout/stderr, task-attributed, including a dead worker's salvaged
+    final lines ([salvaged]). Filters compose; `-f` follows with an id
+    cursor (reference contrast: `ray logs` reads per-node log FILES;
+    here one indexed store answers task/actor/level queries)."""
+    _connect(args.address)
+    import time as _t
+
+    from ray_tpu import state
+    level = _parse_level(args.level)
+
+    def fetch(after_id: int, limit: int):
+        return state.list_logs(task=args.task, actor=args.actor,
+                               node=args.node, level=level,
+                               after_id=after_id, limit=limit)
+
+    rows = fetch(0, args.tail)
+    for r in rows:
+        print(_fmt_log_row(r))
+    if not args.follow:
+        if not rows:
+            print("no log records matched (is graftlog on? "
+                  "RAY_TPU_GRAFTLOG=0 disables it)", file=sys.stderr)
+            return 1
+        return 0
+    last = rows[-1]["id"] if rows else 0
+    try:
+        while True:
+            _t.sleep(max(0.1, args.interval))
+            new = fetch(last, 1000)
+            for r in new:
+                print(_fmt_log_row(r), flush=True)
+            if new:
+                last = new[-1]["id"]
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_metrics(args) -> int:
     _connect(args.address)
     from ray_tpu import state
@@ -528,6 +600,23 @@ def main(argv=None) -> int:
                     help="flame: output path — .json (d3-flamegraph) "
                          "or .collapsed (flamegraph.pl input)")
     sp.set_defaults(fn=cmd_prof)
+
+    sp = sub.add_parser("logs", help="cluster log records (crash-"
+                        "persistent graftlog plane)")
+    sp.add_argument("--address", required=True)
+    sp.add_argument("--task", default=None, help="task id hex prefix")
+    sp.add_argument("--actor", default=None, help="actor id prefix")
+    sp.add_argument("--node", default=None, help="node id (hex12)")
+    sp.add_argument("--level", default=None,
+                    help="minimum level, name or number "
+                         "(WARNING, 30, ...)")
+    sp.add_argument("--tail", type=int, default=100,
+                    help="last N matching records (default 100)")
+    sp.add_argument("-f", "--follow", action="store_true",
+                    help="keep polling for new records")
+    sp.add_argument("--interval", type=float, default=1.0,
+                    help="poll period for --follow, seconds")
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("timeline")
     sp.add_argument("--address", required=True)

@@ -125,16 +125,29 @@ class TaskRecord:
         chain = []
         for n in sorted(self.attempts):
             att = self.attempts[n]
-            chain.append({"attempt": n, "state": att["state"],
-                          "node": att.get("node", ""),
-                          "worker": att.get("worker", ""),
-                          "error": att.get("err", ""),
-                          "transitions": dict(att["ts"])})
+            entry = {"attempt": n, "state": att["state"],
+                     "node": att.get("node", ""),
+                     "worker": att.get("worker", ""),
+                     "error": att.get("err", ""),
+                     "transitions": dict(att["ts"])}
+            # graftlog salvage: the attempt's final log lines, attached
+            # post-mortem when its worker died (attach_logs).
+            if att.get("logs"):
+                entry["log_tail"] = list(att["logs"])
+            chain.append(entry)
         row["attempt_chain"] = chain
         # Root cause: the first attempt that failed explains every
         # retry after it; surface it once, not per-attempt.
         root = next((a for a in chain if a["error"]), None)
         row["root_cause"] = (root["error"] if root else "")
+        # Forensics join: a dead worker's salvaged last words are the
+        # best root-cause context a SIGKILL/OOM leaves behind. Surface
+        # the newest attempt's tail at top level, and fold the final
+        # line into root_cause when the FSM recorded no error string.
+        tails = [a["log_tail"] for a in chain if a.get("log_tail")]
+        row["log_tail"] = tails[-1] if tails else []
+        if not row["root_cause"] and row["log_tail"]:
+            row["root_cause"] = "last log: %s" % row["log_tail"][-1]
         row["trace_id"] = self.trace
         row["parent_span"] = self.pspan
         row["owner"] = self.owner
@@ -432,6 +445,10 @@ class TrailLedger:
         return [r.to_row() for r in recs[:max(0, limit)]]
 
     def get_task(self, task_id: str) -> Optional[dict]:
+        rec = self._resolve(task_id)
+        return rec.to_detail() if rec is not None else None
+
+    def _resolve(self, task_id: str) -> Optional[TaskRecord]:
         rec = self.tasks.get(task_id)
         if rec is None:  # prefix lookup, CLI-friendly
             matches = [r for t, r in self.tasks.items()
@@ -439,7 +456,22 @@ class TrailLedger:
             if len(matches) != 1:
                 return None
             rec = matches[0]
-        return rec.to_detail()
+        return rec
+
+    def attach_task_logs(self, task_id: str, lines: List[str],
+                         attempt: Optional[int] = None,
+                         cap: int = 20) -> bool:
+        """graftlog join: pin a salvaged log tail onto an attempt
+        record (the newest one unless given). Lines accumulate up to
+        ``cap`` — a live tail shipped earlier and the post-mortem
+        salvage both land here, newest kept."""
+        rec = self._resolve(task_id)
+        if rec is None or not rec.attempts or not lines:
+            return False
+        n = attempt if attempt in rec.attempts else rec.latest()[0]
+        att = rec.attempts[n]
+        att["logs"] = (att.get("logs", []) + [str(x) for x in lines])[-cap:]
+        return True
 
     def summary(self) -> List[dict]:
         agg: Dict[str, dict] = {}

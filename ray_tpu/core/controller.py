@@ -21,6 +21,7 @@ Redis-backed persistence is the fault-tolerance extension point).
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -153,6 +154,23 @@ class Controller:
         self.prof = ProfStore(history=GlobalConfig.prof_history,
                               task_cap=GlobalConfig.prof_task_cap,
                               stack_cap=GlobalConfig.prof_stack_cap)
+        # graftlog: bounded, indexed cluster log store. Agents tail
+        # their workers' crash-persistent rings and ship coalesced
+        # batches fire-and-forget (report_log_batch); a dead worker's
+        # salvaged tail arrives via report_log_salvage and joins the
+        # grafttrail attempt record as root-cause context. Dead nodes
+        # are deliberately NOT forgotten — their last records are the
+        # forensics payload.
+        from ray_tpu.core._native.graftlog import LogStore
+        self.logs = LogStore(cap=GlobalConfig.log_cap,
+                             rate_per_s=GlobalConfig.log_rate_per_s,
+                             dedup_window_s=GlobalConfig.log_dedup_window_s)
+        # Salvage can outrun the trail: the agent ships a dead worker's
+        # ring tail the instant waitpid fires, while the driver's trail
+        # flush carrying the task's attempt record is still in flight.
+        # Tails that found no record to join wait here and re-attach on
+        # the next trail fold (or at query time).
+        self._pending_task_logs: Dict[str, list] = {}
         # Infeasible-demand signals, coalesced BY SHAPE (a parked lease
         # retries pick_node every ~250ms; raw per-attempt records would
         # multiply one pending task into dozens of demands and stampede
@@ -452,6 +470,7 @@ class Controller:
                 self.trail.fold_object(tuple(ev))
             except Exception:
                 continue
+        self._retry_pending_task_logs()
         if derived:
             self.task_events.extend(derived)
             if self._event_exporter is not None:
@@ -469,6 +488,7 @@ class Controller:
                                      actor=actor, limit=limit)
 
     async def trail_task(self, task_id: str):
+        self._retry_pending_task_logs()
         return self.trail.get_task(task_id)
 
     async def trail_summary(self) -> list:
@@ -541,6 +561,76 @@ class Controller:
 
     async def prof_stats(self) -> dict:
         return self.prof.stats()
+
+    # -- graftlog (the `ray_tpu logs` + /api/logs backends) -----------
+    async def report_log_batch(self, node_id: bytes, records: list
+                               ) -> None:
+        """graftlog ingest: one fire-and-forget coalesced batch per
+        node per log tick — records tailed from the workers' (and the
+        agent's own) crash-persistent rings. Dedup/rate caps apply
+        inside the store."""
+        self.logs.ingest_batch(node_id.hex()[:12], records)
+
+    @staticmethod
+    def _format_log_line(rec: dict) -> str:
+        t = time.strftime("%H:%M:%S",
+                          time.localtime(int(rec.get("t_ns") or 0) / 1e9))
+        level = logging.getLevelName(int(rec.get("level") or 0))
+        return "%s %.1s [%s] %s" % (
+            t, level or "?",
+            {0: "log", 1: "out", 2: "err", 3: "agt"}.get(
+                int(rec.get("source") or 0), "?"),
+            rec.get("msg", ""))
+
+    async def report_log_salvage(self, node_id: bytes, pid: int,
+                                 meta: dict, records: list) -> None:
+        """Postmortem forensics: a dead worker's ring tail. The rows
+        join the LogStore (seq high-water drops what the live tail
+        already shipped; the salvaged flag exempts them from eviction
+        pressure), and each task mentioned in the tail gets its last
+        lines pinned onto its grafttrail attempt record — `get task`
+        on a SIGKILL'd task then shows its final words as root cause."""
+        hex_id = node_id.hex()[:12]
+        self.logs.ingest_batch(hex_id, records, salvaged=True)
+        by_task: Dict[str, list] = {}
+        for rec in records or ():
+            task = str(rec.get("task") or "")
+            if task:
+                by_task.setdefault(task, []).append(
+                    self._format_log_line(rec))
+        for task, lines in by_task.items():
+            try:
+                if not self.trail.attach_task_logs(task, lines[-20:]):
+                    self._pending_task_logs[task] = lines[-20:]
+            except Exception:
+                continue
+        logger.info("salvaged %d log records from dead pid %s on %s "
+                    "(exit %s)", len(records or ()), pid, hex_id,
+                    meta.get("exit_code"))
+
+    def _retry_pending_task_logs(self) -> None:
+        """Join parked salvage tails onto trail records that have since
+        materialized (the salvage-outran-the-trail race)."""
+        if not self._pending_task_logs:
+            return
+        for task in list(self._pending_task_logs):
+            try:
+                if self.trail.attach_task_logs(
+                        task, self._pending_task_logs[task]):
+                    del self._pending_task_logs[task]
+            except Exception:
+                del self._pending_task_logs[task]
+
+    async def list_logs(self, task=None, actor=None, node=None,
+                        level: int = 0, since_ns: int = 0,
+                        after_id: int = 0, limit: int = 100) -> list:
+        return self.logs.list(task=task or "", actor=actor or "",
+                              node=node or "", level=int(level or 0),
+                              since_ns=int(since_ns or 0),
+                              after_id=int(after_id or 0), limit=limit)
+
+    async def log_stats(self) -> dict:
+        return self.logs.stats()
 
     async def report_native_spans(self, spans: list) -> None:
         """graftscope spans from worker flushers / agent metric ticks.

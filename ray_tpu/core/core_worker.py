@@ -422,6 +422,22 @@ class CoreWorker:
         graftprof.configure_from_flags()
         if graftprof.enabled():
             graftprof.start()
+        # Crash-persistent log ring: open logring-<pid> in the node's
+        # store dir (learned from the registration reply) and replay
+        # any records the logger parked before the dir was known. In
+        # worker mode, raw stdout/stderr lines tee into the ring too —
+        # the agent still gets every byte through the pipe, but the
+        # ring copy carries task attribution and survives a SIGKILL
+        # for postmortem salvage.
+        from ray_tpu.core._native import graftlog
+        graftlog.configure_from_flags()
+        if graftlog.enabled() and self.store_dir:
+            try:
+                graftlog.open_ring(self.store_dir)
+                if self.mode == "worker":
+                    graftlog.install_stdio_tee()
+            except Exception as e:
+                logger.debug("graftlog ring unavailable: %r", e)
         spawn(self._task_event_flusher())
         if self.mode == "driver" and GlobalConfig.log_to_driver:
             # Worker prints stream to this driver (reference:

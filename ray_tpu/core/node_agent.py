@@ -238,6 +238,14 @@ class NodeAgent:
         # the pulse's on-CPU%/GIL% gauges.
         self._prof_buf: List[dict] = []
         self._prof_window: List[tuple] = []  # (rx_s, wall, oncpu, gil)
+        # graftlog: one RingReader cursor per hosted pid (plus our own);
+        # the log tick tails the rings and ships coalesced batches to
+        # the controller LogStore fire-and-forget. On worker death the
+        # ring FILE outlives the process — the salvage path decodes the
+        # tail post-mortem and forwards it for the grafttrail join.
+        self._log_on = False  # set from config in start()
+        self._log_readers: Dict[int, object] = {}
+        self._log_buf: List[dict] = []
         self._node_hex = self.node_id.hex()[:12]
         self._shutdown = False
 
@@ -305,6 +313,17 @@ class NodeAgent:
         if graftprof.enabled():
             graftprof.start()
             spawn(self._prof_loop())
+        # graftlog: the agent writes its own crash-persistent ring and
+        # tails every hosted worker's ring on the log tick.
+        from ray_tpu.core._native import graftlog
+        graftlog.configure_from_flags()
+        self._log_on = graftlog.enabled()
+        if self._log_on:
+            try:
+                graftlog.open_ring(self.store.dir)
+            except Exception as e:
+                logger.debug("graftlog agent ring unavailable: %r", e)
+            spawn(self._log_loop())
         if GlobalConfig.memory_monitor_refresh_ms > 0:
             spawn(self._memory_monitor_loop())
         if GlobalConfig.worker_prestart > 0:
@@ -434,6 +453,22 @@ class NodeAgent:
                             age_cap = 600
                         elif name.startswith(("ingest-", "put-")):
                             age_cap = 120
+                        elif name.startswith("logring-"):
+                            # graftlog rings whose writer is gone and
+                            # whose salvage window has passed (salvage
+                            # unlinks on success; this catches ship
+                            # failures, agent restarts, and external
+                            # processes — e.g. a dead driver). mtime is
+                            # creation time here: mmap stores don't
+                            # touch it, so the age gate is just a grace
+                            # period for an in-flight salvage.
+                            try:
+                                rpid = int(name.rsplit("-", 1)[1])
+                            except (ValueError, IndexError):
+                                continue
+                            if self._pid_alive(rpid):
+                                continue
+                            age_cap = 60
                         else:
                             continue
                         p = os.path.join(self.store.dir, name)
@@ -610,10 +645,28 @@ class NodeAgent:
                     except Exception:
                         pass
 
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except OSError:
+            return True  # EPERM etc: it exists
+
     async def _on_worker_death(self, w: WorkerProc) -> None:
         self.workers.pop(w.worker_id, None)
         if w in self.idle_workers:
             self.idle_workers.remove(w)
+        # Forensics first: the dead process's log ring is still on the
+        # filesystem — salvage the tail before anything else can race
+        # the file away.
+        try:
+            await self._salvage_worker_log(w)
+        except Exception as e:
+            logger.debug("log salvage failed for pid %s: %r",
+                         w.proc.pid, e)
         scope = getattr(w, "cgroup_scope", None)
         if scope is not None:
             scope.cleanup()
@@ -916,13 +969,31 @@ class NodeAgent:
             self._start_log_pump(proc)
         return w
 
+    # Coalescing bounds for the log pump: a fast-printing worker ships
+    # at most _LOG_PUMP_BATCH lines per publish RPC; the queue bound is
+    # what back-pressures the pipe when the controller falls behind.
+    _LOG_PUMP_QUEUE = 1024
+    _LOG_PUMP_BATCH = 128
+
     def _start_log_pump(self, proc) -> None:
         """Forward the worker's stdout/stderr lines to the controller's
         log_events pubsub channel (reference: _private/log_monitor.py
-        tailing + worker.py print_worker_logs on the driver)."""
+        tailing + worker.py print_worker_logs on the driver).
+
+        Two threads around one bounded queue. The reader drains the
+        pipe and blocks on ``put`` when the queue fills, so a
+        fast-printing worker still back-pressures through the pipe
+        instead of queueing unbounded lines. The shipper BLOCKS for the
+        first line, then drains whatever else is already queued into
+        one batched publish — a lone trailing line ships immediately
+        (no time-based flush that would strand it until the NEXT line
+        arrives), while a burst coalesces into ~batch-sized RPCs
+        instead of a controller round-trip per line."""
+        import queue
         import threading
 
         loop = asyncio.get_running_loop()
+        q: "queue.Queue" = queue.Queue(maxsize=self._LOG_PUMP_QUEUE)
 
         async def _publish(lines):
             try:
@@ -932,22 +1003,38 @@ class NodeAgent:
             except Exception:
                 pass
 
-        def pump():
-            # Publish per line, AWAITING each RPC: the pump thread then
-            # drains at controller speed and the pipe back-pressures a
-            # fast-printing worker (fire-and-forget would queue unbounded
-            # coroutines). A time-batched flush is wrong here — it would
-            # strand trailing lines until the NEXT line arrives.
+        def reader():
             assert proc.stdout is not None
             for line in proc.stdout:
+                q.put(line.rstrip("\n"))
+            q.put(None)  # EOF: flush and stop the shipper
+
+        def shipper():
+            eof = False
+            while not eof:
+                item = q.get()
+                if item is None:
+                    return
+                batch = [item]
+                while len(batch) < self._LOG_PUMP_BATCH:
+                    try:
+                        nxt = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        eof = True
+                        break
+                    batch.append(nxt)
                 try:
                     asyncio.run_coroutine_threadsafe(
-                        _publish([line.rstrip("\n")]), loop).result(10)
+                        _publish(batch), loop).result(10)
                 except Exception:
                     pass
 
-        threading.Thread(target=pump, daemon=True,
+        threading.Thread(target=reader, daemon=True,
                          name=f"logpump-{proc.pid}").start()
+        threading.Thread(target=shipper, daemon=True,
+                         name=f"logship-{proc.pid}").start()
 
     async def register_worker(self, worker_id: bytes, pid: int, port: int) -> dict:
         w = self._pending_registration.pop(pid, None)
@@ -1031,6 +1118,88 @@ class NodeAgent:
                 raise
             except Exception as e:
                 logger.debug("prof forward failed: %r", e)
+
+    def _log_rows(self, pid: int, recs) -> List[dict]:
+        return [{"pid": pid, "level": r.level, "source": r.source,
+                 "seq": r.seq, "t_ns": r.t_ns, "task": r.task,
+                 "actor": r.actor, "msg": r.msg, "line_len": r.line_len}
+                for r in recs]
+
+    async def _log_loop(self) -> None:
+        """graftlog tick: tail every hosted worker's ring file (plus
+        our own) from persistent cursors and ship the coalesced batch
+        to the controller LogStore fire-and-forget (the grafttrail
+        transport shape). Readers for vanished pids are dropped — the
+        death path salvages their rings."""
+        from ray_tpu.core._native import graftlog
+        period = max(0.1, GlobalConfig.log_flush_ms / 1000)
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            try:
+                pids = {w.proc.pid for w in self.workers.values()}
+                pids.add(os.getpid())
+                for pid in list(self._log_readers):
+                    if pid not in pids:
+                        del self._log_readers[pid]
+                for pid in pids:
+                    rd = self._log_readers.get(pid)
+                    if rd is None:
+                        rd = self._log_readers[pid] = graftlog.RingReader(
+                            graftlog.ring_path(self.store.dir, pid))
+                    self._log_buf.extend(
+                        self._log_rows(pid, rd.poll(2048)))
+            except Exception as e:
+                logger.debug("log tail failed: %r", e)
+            if not self._log_buf:
+                continue
+            if len(self._log_buf) > 8192:  # forward-outage bound
+                del self._log_buf[:len(self._log_buf) - 8192]
+            batch, self._log_buf = self._log_buf, []
+            try:
+                await asyncio.wait_for(
+                    self.controller.call("report_log_batch",
+                                         self.node_id.binary(), batch),
+                    timeout=2.0)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # Re-buffer (capped) and retry next tick.
+                self._log_buf = (batch + self._log_buf)[-8192:]
+                logger.debug("log forward failed: %r", e)
+
+    async def _salvage_worker_log(self, w: WorkerProc) -> None:
+        """Postmortem forensics: decode the dead process's ring file
+        tail and forward it for LogStore ingest + the grafttrail
+        attempt join. The controller's per-(node, pid) seq high-water
+        drops whatever the live tail already shipped, so the overlap
+        is harmless. The file is unlinked only after a successful
+        ship — the sweep reclaims it otherwise."""
+        if not self._log_on:
+            return
+        from ray_tpu.core._native import graftlog
+        pid = w.proc.pid
+        self._log_readers.pop(pid, None)
+        path = graftlog.ring_path(self.store.dir, pid)
+        meta, tail = graftlog.salvage_ring(
+            path, int(GlobalConfig.log_tail_lines))
+        if not meta:
+            return
+        meta["exit_code"] = w.proc.returncode
+        try:
+            await asyncio.wait_for(
+                self.controller.call(
+                    "report_log_salvage", self.node_id.binary(), pid,
+                    meta, self._log_rows(pid, tail)),
+                timeout=2.0)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.debug("log salvage ship failed for pid %s: %r", pid, e)
+            return
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
     async def _prestart_workers(self, n: int) -> None:
         """Warm the pool at startup (reference: worker_pool.cc

@@ -181,6 +181,23 @@ def _load_lib() -> ctypes.CDLL:
     lib.prof_gil_wait_ns.argtypes = []
     lib.prof_gil_probes.restype = ctypes.c_uint64
     lib.prof_gil_probes.argtypes = []
+    # graftlog crash-persistent log ring (log_core.cc).
+    lib.log_ring_open.restype = ctypes.c_int
+    lib.log_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.log_ring_close.argtypes = []
+    lib.log_emit.restype = ctypes.c_uint64
+    lib.log_emit.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int]
+    lib.log_enabled.restype = ctypes.c_int
+    lib.log_enabled.argtypes = []
+    lib.log_set_enabled.argtypes = [ctypes.c_int]
+    lib.log_drain.restype = ctypes.c_int
+    lib.log_drain.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.log_emitted.restype = ctypes.c_uint64
+    lib.log_emitted.argtypes = []
+    lib.log_dropped.restype = ctypes.c_uint64
+    lib.log_dropped.argtypes = []
     return lib
 
 

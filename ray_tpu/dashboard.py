@@ -17,6 +17,9 @@ React client is out of scope). Endpoints:
     GET /api/native      -> native hot-path latency rollup (graftscope)
     GET /api/cluster     -> graftpulse SLO view (per-op p50/p99, per-node
                             occupancy + pulse health, resident totals)
+    GET /api/logs?task=&actor=&node=&level=30&tail=N&after_id=&stats=1
+                         -> graftlog cluster log records (crash-
+                            persistent rings; salvaged tails included)
     GET /api/prof?view=top|flame|collapsed|stats&task=&actor=&node=
                  &seconds=&limit=
                          -> graftprof continuous-profiling queries
@@ -69,6 +72,7 @@ _PAGE = """<!doctype html>
 <a href="/api/jobs">jobs</a> · <a href="/api/native">native</a> ·
 <a href="/api/cluster">cluster</a> ·
 <a href="/api/prof?view=top">prof</a> · <a href="/flame">flame</a> ·
+<a href="/api/logs?tail=100">logs</a> ·
 <a href="/api/timeline">timeline</a> · <a href="/metrics">metrics</a> ·
 <a href="/metrics/cluster">metrics/cluster</a></p>
 <script>
@@ -301,6 +305,20 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     body = state.prof_top(
                         limit=int(q.get("limit", 30)), **filt)
+                self._send(200, json.dumps(body, default=str).encode())
+                return
+            if path == "/api/logs":
+                # graftlog: indexed cluster log records, incl. salvaged
+                # final lines of dead workers.  stats=1 -> store stats.
+                if q.get("stats") == "1":
+                    body = state.log_stats()
+                else:
+                    body = state.list_logs(
+                        task=q.get("task"), actor=q.get("actor"),
+                        node=q.get("node"),
+                        level=int(q.get("level", 0) or 0),
+                        after_id=int(q.get("after_id", 0) or 0),
+                        limit=int(q.get("tail", q.get("limit", 100))))
                 self._send(200, json.dumps(body, default=str).encode())
                 return
             if path == "/api/state/summary":

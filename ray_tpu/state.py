@@ -242,3 +242,24 @@ def prof_stats() -> dict:
     """ProfStore occupancy: nodes, tracked tasks, total samples,
     drops reported by worker rings."""
     return _ctl("prof_stats")
+
+
+def list_logs(task: Optional[str] = None, actor: Optional[str] = None,
+              node: Optional[str] = None, level: int = 0,
+              since_ns: int = 0, after_id: int = 0,
+              limit: int = 100) -> List[dict]:
+    """Cluster log records from the graftlog plane, time-ordered.
+    Filters: task id hex prefix, actor id prefix, node hex12, minimum
+    logging level (e.g. 30 for WARNING+), wall-clock floor (ns).
+    ``after_id`` is the follow cursor: pass the last row's ``id`` to
+    fetch only newer records (the `ray_tpu logs -f` loop). Salvaged
+    rows (``salvaged: true``) are a dead worker's final lines,
+    recovered from its crash-persistent ring."""
+    return _ctl("list_logs", task, actor, node, level, since_ns,
+                after_id, limit)
+
+
+def log_stats() -> dict:
+    """LogStore occupancy and storm-control counters: records, cap,
+    ingested/suppressed/deduped/evicted/salvaged, per-level mix."""
+    return _ctl("log_stats")

@@ -78,6 +78,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--prof-cc", default=None,
                     help="C side of the graftprof record schema "
                          "(default: csrc/prof_core.h)")
+    ap.add_argument("--log-py", default=None,
+                    help="Python side of the graftlog record schema "
+                         "(default: ray_tpu/core/_native/graftlog.py)")
+    ap.add_argument("--log-cc", default=None,
+                    help="C side of the graftlog record schema "
+                         "(default: csrc/log_core.h)")
     ap.add_argument("--rpc-root", default=None,
                     help="root scanned for RPC call sites/handlers "
                          "(default: ray_tpu/); 'none' disables")
@@ -208,6 +214,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings.append(Finding(
                 "<wire>", 1, wire_schema.RULE, "error",
                 f"graftprof schema sources missing: {pr_py} / {pr_cc}"))
+        # Pass 3h: graftlog crash-persistent log record schema.
+        lg_py = args.log_py or os.path.join(
+            root, "ray_tpu", "core", "_native", "graftlog.py")
+        lg_cc = args.log_cc or os.path.join(root, "csrc", "log_core.h")
+        if os.path.exists(lg_py) and os.path.exists(lg_cc):
+            findings += wire_schema.run_log(
+                lg_py, lg_cc,
+                os.path.relpath(lg_py, root).replace(os.sep, "/"),
+                os.path.relpath(lg_cc, root).replace(os.sep, "/"))
+        elif args.log_py or args.log_cc or not explicit_paths:
+            findings.append(Finding(
+                "<wire>", 1, wire_schema.RULE, "error",
+                f"graftlog schema sources missing: {lg_py} / {lg_cc}"))
         # Pass 3d: ctypes binding signatures vs the C exports of every
         # translation unit in the shared library.
         ct_py = args.store_py or os.path.join(
@@ -215,7 +234,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         ct_ccs = [os.path.join(root, "csrc", f)
                   for f in ("object_store.cc", "store_server.cc",
                             "copy_core.cc", "scope_core.cc",
-                            "prof_core.cc")]
+                            "prof_core.cc", "log_core.cc")]
         ct_ccs_found = [p for p in ct_ccs if os.path.exists(p)]
         if os.path.exists(ct_py) and ct_ccs_found:
             findings += wire_schema.run_ctypes(

@@ -1524,3 +1524,287 @@ def run_prof(py_path: str, cc_path: str, py_rel: str, cc_rel: str
             err(py_rel, f"prof geometry drift: {pyname}={pv} vs "
                         f"C kProf{cname}={cv}")
     return findings
+
+
+# ==========================================================================
+# Pass 3h — graftlog log-record drift.
+#
+# The 256-byte crash-persistent log record is hand-duplicated: source
+# kinds, field layout and the ring geometry live in
+# `ray_tpu/core/_native/graftlog.py` (LOG_SRC_*, LOG_RECORD_FIELDS,
+# LOG_RECORD struct format, LOG_RECORD_SIZE, LOG_RING_SLOTS /
+# LOG_HEADER_SIZE / LOG_TASK_CAP / LOG_ACTOR_CAP / LOG_MSG_CAP /
+# LOG_MAGIC / LOG_RING_VERSION) and again in `csrc/log_core.h` (kLogSrc*
+# constants, packed struct LogWireRec with char[] payload fields,
+# kLogRecordSize, the kLog* geometry constexprs). This record crosses a
+# PROCESS boundary through a file: the C emit path writes it, the
+# Python agent tails it live and salvages it after the writer is
+# SIGKILLed — drift turns every postmortem tail into garbage (records
+# still parse: wrong task attribution, truncated or shifted messages)
+# or desyncs the slot stride so salvage reads straddle records.
+# Re-derive both sides and fail on any mismatch: source name/value,
+# field name/width/order, record size, geometry scalar (incl. the file
+# magic and version, which gate salvage of rings from older runs).
+# ==========================================================================
+
+# C geometry constant -> Python name; kLogSrc* are record sources.
+# Magic is hex in C — parsed with int(x, 0) below.
+_LOG_GEOMETRY = {
+    "RingSlots": "LOG_RING_SLOTS",
+    "HeaderSize": "LOG_HEADER_SIZE",
+    "TaskCap": "LOG_TASK_CAP",
+    "ActorCap": "LOG_ACTOR_CAP",
+    "MsgCap": "LOG_MSG_CAP",
+    "Magic": "LOG_MAGIC",
+    "RingVersion": "LOG_RING_VERSION",
+}
+
+
+def _log_py_name(c_kind: str) -> str:
+    """kLogSrcStdout -> LOG_SRC_STDOUT; kLogSrcCount -> LOG_SRC_COUNT."""
+    return "LOG_" + _camel_to_upper_snake(c_kind)
+
+
+def _log_struct_widths(fmt: str, errors: List[str]) -> List[int]:
+    """Per-FIELD widths of a struct format that may carry "Ns" tokens
+    (fixed char arrays — one field of width N, unlike "NB" which is N
+    one-byte fields). _STRUCT_CHAR_WIDTHS deliberately has no "s"."""
+    widths: List[int] = []
+    body = fmt.lstrip("<>=!@")
+    pos = 0
+    for m in re.finditer(r"(\d*)([a-zA-Z])", body):
+        if m.start() != pos:
+            errors.append(f"LOG_RECORD: unparsed format text "
+                          f"{body[pos:m.start()]!r}")
+        pos = m.end()
+        count, ch = m.group(1), m.group(2)
+        if ch == "s":
+            widths.append(int(count) if count else 1)
+            continue
+        w = _STRUCT_CHAR_WIDTHS.get(ch)
+        if w is None:
+            errors.append(f"LOG_RECORD: unknown format char {ch!r}")
+            continue
+        widths.extend([w] * (int(count) if count else 1))
+    if pos != len(body):
+        errors.append(f"LOG_RECORD: unparsed format tail "
+                      f"{body[pos:]!r}")
+    return widths
+
+
+class LogPySchema:
+    def __init__(self) -> None:
+        self.kinds: Dict[str, int] = {}          # LOG_SRC_STDOUT -> 1
+        self.record_fields: List[Tuple[str, int]] = []
+        self.struct_widths: List[int] = []       # from "<BBHIQ32s..."
+        self.record_size: Optional[int] = None
+        self.geometry: Dict[str, int] = {}       # LOG_RING_SLOTS -> 4096
+
+
+def parse_log_py(path: str) -> Tuple[LogPySchema, List[str]]:
+    errors: List[str] = []
+    schema = LogPySchema()
+    geometry_names = set(_LOG_GEOMETRY.values())
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1 \
+                or not isinstance(stmt.targets[0], ast.Name):
+            continue
+        name, val = stmt.targets[0].id, stmt.value
+        if name == "LOG_RECORD_FIELDS":
+            if not isinstance(val, ast.Tuple):
+                errors.append("LOG_RECORD_FIELDS is not a tuple")
+                continue
+            for el in val.elts:
+                if (isinstance(el, ast.Tuple) and len(el.elts) == 2
+                        and isinstance(el.elts[0], ast.Constant)):
+                    w = _const_int(el.elts[1])
+                    if w is None:
+                        errors.append("LOG_RECORD_FIELDS: bad width")
+                        continue
+                    schema.record_fields.append((el.elts[0].value, w))
+                else:
+                    errors.append("LOG_RECORD_FIELDS: bad entry shape")
+        elif name == "LOG_RECORD":
+            if (isinstance(val, ast.Call) and val.args
+                    and isinstance(val.args[0], ast.Constant)):
+                schema.struct_widths = _log_struct_widths(
+                    str(val.args[0].value), errors)
+            else:
+                errors.append("LOG_RECORD is not struct.Struct(<literal>)")
+        elif name == "LOG_RECORD_SIZE":
+            schema.record_size = _const_int(val)
+            if schema.record_size is None:
+                errors.append("cannot evaluate LOG_RECORD_SIZE")
+        elif name in geometry_names:
+            v = _const_int(val)
+            if v is None:
+                errors.append(f"cannot evaluate {name}")
+            else:
+                schema.geometry[name] = v
+        elif name.startswith("LOG_SRC_"):
+            if isinstance(val, (ast.Dict, ast.List, ast.Set)):
+                continue  # lookup tables (LOG_SRC_NAMES), not sources
+            v = _const_int(val)
+            if v is None:
+                errors.append(f"cannot evaluate {name}")
+            else:
+                schema.kinds[name] = v
+        # Other LOG_* names (LOG_HEADER, the header struct) are emit/
+        # salvage implementation detail, not part of the record contract.
+    if not schema.kinds:
+        errors.append("no LOG_SRC_* source constants found")
+    if not schema.record_fields:
+        errors.append("LOG_RECORD_FIELDS not found")
+    if not schema.struct_widths:
+        errors.append("LOG_RECORD struct format not found")
+    return schema, errors
+
+
+class LogCSchema:
+    def __init__(self) -> None:
+        self.kinds: Dict[str, int] = {}          # SrcStdout -> 1
+        self.record_fields: List[Tuple[str, int]] = []
+        self.record_size: Optional[int] = None
+        self.geometry: Dict[str, int] = {}       # RingSlots -> 4096
+
+
+def parse_log_c(path: str) -> Tuple[LogCSchema, List[str]]:
+    errors: List[str] = []
+    schema = LogCSchema()
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+
+    # kLogMagic is hex; int(x, 0) accepts both bases.
+    for m in re.finditer(
+            r"kLog([A-Za-z0-9_]+)\s*=\s*(0[xX][0-9a-fA-F]+|\d+)", text):
+        name, value = m.group(1), int(m.group(2), 0)
+        if name == "RecordSize":
+            continue  # checked via the constexpr regex below
+        if name in _LOG_GEOMETRY:
+            schema.geometry[name] = value
+        else:
+            schema.kinds[name] = value
+    if not schema.kinds:
+        errors.append("no kLogSrc* source constants found")
+    for cname in _LOG_GEOMETRY:
+        if cname not in schema.geometry:
+            errors.append(f"kLog{cname} constexpr not found")
+
+    m = re.search(r"constexpr\s+int\s+kLogRecordSize\s*=\s*(\d+)\s*;",
+                  text)
+    if m:
+        schema.record_size = int(m.group(1))
+    else:
+        errors.append("kLogRecordSize constexpr not found")
+
+    m = re.search(r"struct\s+LogWireRec\s*\{(.*?)\};", text, re.S)
+    if not m:
+        errors.append("struct LogWireRec not found")
+    else:
+        # Payload fields are char arrays (`char task[32]` or sized by a
+        # kLog* cap constant) — the prof field regex can't see those.
+        for fm in re.finditer(
+                r"^\s*([A-Za-z_][A-Za-z0-9_]*)\s+([A-Za-z_][A-Za-z0-9_]*)"
+                r"\s*(?:\[\s*([A-Za-z0-9_]+)\s*\])?\s*;", m.group(1), re.M):
+            ctype, fname, dim = fm.group(1), fm.group(2), fm.group(3)
+            width = _C_TYPE_WIDTHS.get(ctype)
+            if width is None:
+                errors.append(f"struct LogWireRec: unknown type {ctype}")
+                continue
+            if dim is not None:
+                if dim.isdigit():
+                    n = int(dim)
+                elif dim.startswith("kLog") \
+                        and dim[4:] in schema.geometry:
+                    n = schema.geometry[dim[4:]]
+                else:
+                    errors.append(f"struct LogWireRec: cannot size "
+                                  f"{fname}[{dim}]")
+                    continue
+                width *= n
+            schema.record_fields.append((fname, width))
+        if not schema.record_fields:
+            errors.append("struct LogWireRec has no parsable fields")
+    return schema, errors
+
+
+def run_log(py_path: str, cc_path: str, py_rel: str, cc_rel: str
+            ) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def err(path: str, msg: str) -> None:
+        findings.append(Finding(path, 1, RULE, "error", msg))
+
+    py, py_errors = parse_log_py(py_path)
+    cc, cc_errors = parse_log_c(cc_path)
+    for e in py_errors:
+        err(py_rel, e)
+    for e in cc_errors:
+        err(cc_rel, e)
+    if py_errors or cc_errors:
+        return findings
+
+    # 1. Source tables: same names (under the mechanical rename), same
+    #    values.
+    cc_kinds = {_log_py_name(k): v for k, v in cc.kinds.items()}
+    for name in sorted(set(py.kinds) | set(cc_kinds)):
+        if name not in py.kinds:
+            err(py_rel, f"log source {name!r} exists in C (kLogSrc*) "
+                        f"but has no LOG_SRC_* constant in graftlog.py")
+        elif name not in cc_kinds:
+            err(cc_rel, f"log source {name!r} exists in Python "
+                        f"(LOG_SRC_*) but has no kLogSrc* constant")
+        elif py.kinds[name] != cc_kinds[name]:
+            err(py_rel, f"log source {name!r} drift: Python "
+                        f"{py.kinds[name]} vs C {cc_kinds[name]}")
+
+    # 2. Record layout: field-by-field name/width/order (char-array
+    #    widths already folded in on the C side).
+    if len(py.record_fields) != len(cc.record_fields):
+        err(py_rel, f"log record drift: Python declares "
+                    f"{len(py.record_fields)} fields, C struct has "
+                    f"{len(cc.record_fields)}")
+    for (pn, pw), (cn, cw) in zip(py.record_fields, cc.record_fields):
+        if pn != cn:
+            err(py_rel, f"log record field order drift: Python has "
+                        f"{pn!r} where C has {cn!r}")
+        elif pw != cw:
+            err(py_rel, f"log record field {pn!r} width drift: Python "
+                        f"{pw} vs C {cw}")
+
+    # 3. Struct format chars (incl. "Ns" payload tokens) vs the
+    #    declared field widths.
+    declared = [w for _, w in py.record_fields]
+    if py.struct_widths != declared:
+        err(py_rel, f"LOG_RECORD format widths {py.struct_widths} != "
+                    f"LOG_RECORD_FIELDS widths {declared}")
+
+    # 4. Record size: both constants and both layouts must agree — the
+    #    slot stride; a mismatch shears every salvage read.
+    psum = sum(w for _, w in py.record_fields)
+    csum = sum(w for _, w in cc.record_fields)
+    if py.record_size is not None and psum != py.record_size:
+        err(py_rel, f"LOG_RECORD_FIELDS pack to {psum} bytes but "
+                    f"LOG_RECORD_SIZE={py.record_size}")
+    if cc.record_size is not None and csum != cc.record_size:
+        err(cc_rel, f"struct LogWireRec packs to {csum} bytes but "
+                    f"kLogRecordSize={cc.record_size}")
+    if py.record_size is not None and cc.record_size is not None \
+            and py.record_size != cc.record_size:
+        err(py_rel, f"log record size drift: LOG_RECORD_SIZE="
+                    f"{py.record_size} vs kLogRecordSize="
+                    f"{cc.record_size}")
+
+    # 5. Ring geometry: file magic/version gate salvage of foreign
+    #    rings; slots/header size the mmap and the slot indexing; the
+    #    payload caps bound decode on both sides.
+    for cname, pyname in sorted(_LOG_GEOMETRY.items()):
+        pv, cv = py.geometry.get(pyname), cc.geometry.get(cname)
+        if pv is None:
+            err(py_rel, f"{pyname} not found in graftlog.py")
+        elif cv is not None and pv != cv:
+            err(py_rel, f"log geometry drift: {pyname}={pv} vs "
+                        f"C kLog{cname}={cv}")
+    return findings

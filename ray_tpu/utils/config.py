@@ -151,6 +151,14 @@ _flag("prof_hz", int, 67, "graftprof sampling rate (ticks/s) for both the native
 _flag("prof_history", int, 120, "Profile flush windows retained per node in the controller ProfStore (the `prof top --seconds` query window).")
 _flag("prof_task_cap", int, 512, "Distinct (task, actor) merged profiles retained in the controller ProfStore (LRU eviction).")
 _flag("prof_stack_cap", int, 256, "Distinct folded stacks retained per task profile (coldest evicted on merge).")
+_flag("graftlog", bool, True, "Crash-persistent log plane (graftlog): every worker and agent appends task-attributed log records (logger calls + captured stdout/stderr) to a MAP_SHARED logring-<pid> file in the store dir; agents tail the rings into the controller LogStore and salvage a dead worker's final lines into its grafttrail attempt record. RAY_TPU_GRAFTLOG=0 disables emit, tailing and salvage (Python seam and C emit path read the same env).")
+_flag("log_flush_ms", int, 1000, "graftlog agent tick: ring-tail and batch-ship period.")
+_flag("log_cap", int, 20000, "Log records retained in the controller LogStore (oldest sub-WARNING records evict first; salvaged records last).")
+_flag("log_rate_per_s", float, 200.0, "Per-worker sustained ingest cap at the controller LogStore (token bucket, 2x burst); suppressed records are counted, salvage bypasses.")
+_flag("log_dedup_window_s", float, 5.0, "Error-storm dedup: an identical (node, pid, task, message) inside this window bumps a repeats counter instead of storing a new record.")
+_flag("log_tail_lines", int, 200, "Ring records salvaged from a dead worker's logring file and attached (last 20) to its grafttrail attempt record.")
+_flag("log_file_max_bytes", int, 16 << 20, "Rotation threshold for session logs/<component>-<pid>.log files (0 = unbounded legacy behavior).")
+_flag("log_file_backups", int, 3, "Rotated session log files kept per component.")
 
 
 class Config:

@@ -660,9 +660,11 @@ OS_CC = os.path.join(REPO, "csrc", "object_store.cc")
 COPY_CC = os.path.join(REPO, "csrc", "copy_core.cc")
 SCOPE_CORE_CC = os.path.join(REPO, "csrc", "scope_core.cc")
 PROF_CORE_CC = os.path.join(REPO, "csrc", "prof_core.cc")
-CT_CCS = [OS_CC, STORE_CC, COPY_CC, SCOPE_CORE_CC, PROF_CORE_CC]
+LOG_CORE_CC = os.path.join(REPO, "csrc", "log_core.cc")
+CT_CCS = [OS_CC, STORE_CC, COPY_CC, SCOPE_CORE_CC, PROF_CORE_CC,
+          LOG_CORE_CC]
 CT_RELS = ["object_store.cc", "store_server.cc", "copy_core.cc",
-           "scope_core.cc", "prof_core.cc"]
+           "scope_core.cc", "prof_core.cc", "log_core.cc"]
 
 
 def _ctypes_run(py=STORE_PY, ccs=None, rels=None):
@@ -681,7 +683,7 @@ def test_ctypes_schema_detects_arity_drift(tmp_path):
                   "int copy_linkat(int src_fd, const char* dst, int flags)",
                   "copy_core.cc")
     fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc, SCOPE_CORE_CC,
-                          PROF_CORE_CC])
+                          PROF_CORE_CC, LOG_CORE_CC])
     assert fs and all(f.rule == "wire-drift" for f in fs)
     assert any("arity" in f.message and "copy_linkat" in f.message
                for f in fs), [f.render() for f in fs]
@@ -691,7 +693,7 @@ def test_ctypes_schema_detects_arg_width_drift(tmp_path):
     cc = _mutated(tmp_path, COPY_CC, "int nsegs)", "uint64_t nsegs)",
                   "copy_core.cc")
     fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc, SCOPE_CORE_CC,
-                          PROF_CORE_CC])
+                          PROF_CORE_CC, LOG_CORE_CC])
     assert fs and any("width" in f.message
                       and "copy_write_scatter" in f.message
                       for f in fs), [f.render() for f in fs]
@@ -701,7 +703,7 @@ def test_ctypes_schema_detects_restype_drift(tmp_path):
     cc = _mutated(tmp_path, COPY_CC, "int copy_engine_threads(",
                   "uint64_t copy_engine_threads(", "copy_core.cc")
     fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc, SCOPE_CORE_CC,
-                          PROF_CORE_CC])
+                          PROF_CORE_CC, LOG_CORE_CC])
     assert fs and any("restype" in f.message
                       and "copy_engine_threads" in f.message
                       for f in fs), [f.render() for f in fs]
@@ -737,7 +739,7 @@ def test_ctypes_schema_detects_missing_c_definition(tmp_path):
     cc = _mutated(tmp_path, COPY_CC, "int copy_linkat(",
                   "int copy_linkat_v2(", "copy_core.cc")
     fs = _ctypes_run(ccs=[OS_CC, STORE_CC, cc, SCOPE_CORE_CC,
-                          PROF_CORE_CC])
+                          PROF_CORE_CC, LOG_CORE_CC])
     assert fs and any("no C definition" in f.message
                       and "copy_linkat" in f.message
                       for f in fs), [f.render() for f in fs]
@@ -955,6 +957,99 @@ def test_prof_schema_detects_ring_geometry_drift(tmp_path):
                   "PROF_RING_CAP = 2048", "graftprof.py")
     fs = wire_schema.run_prof(py, PROF_CC, "py", "cc")
     assert fs and any("RING_CAP" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# pass 3h — graftlog crash-persistent log record drift
+# ---------------------------------------------------------------------------
+
+LOG_PY = os.path.join(REPO, "ray_tpu", "core", "_native", "graftlog.py")
+LOG_CC = os.path.join(REPO, "csrc", "log_core.h")
+
+
+def test_log_schema_repo_in_sync():
+    fs = wire_schema.run_log(LOG_PY, LOG_CC, "py", "cc")
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_log_schema_detects_source_value_drift(tmp_path):
+    cc = _mutated(tmp_path, LOG_CC, "kLogSrcStderr = 2",
+                  "kLogSrcStderr = 5", "log_core.h")
+    fs = wire_schema.run_log(LOG_PY, cc, "py", "cc")
+    assert fs and all(f.rule == "wire-drift" for f in fs)
+    assert any("SRC_STDERR" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_log_schema_detects_missing_source(tmp_path):
+    cc = _mutated(tmp_path, LOG_CC, "kLogSrcAgent = 3",
+                  "kLogSrcDaemon = 3", "log_core.h")
+    fs = wire_schema.run_log(LOG_PY, cc, "py", "cc")
+    assert any("SRC_AGENT" in f.message or "SRC_DAEMON" in f.message
+               for f in fs), [f.render() for f in fs]
+
+
+def test_log_schema_detects_payload_width_drift(tmp_path):
+    # Char-array payload widths must fold into the field comparison —
+    # a shrunken msg cap shifts every later salvage read.
+    cc = _mutated(tmp_path, LOG_CC, "char msg[196];",
+                  "char msg[180];", "log_core.h")
+    fs = wire_schema.run_log(LOG_PY, cc, "py", "cc")
+    assert fs and any("msg" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_log_schema_detects_field_width_drift(tmp_path):
+    cc = _mutated(tmp_path, LOG_CC, "uint16_t line_len;",
+                  "uint32_t line_len;", "log_core.h")
+    fs = wire_schema.run_log(LOG_PY, cc, "py", "cc")
+    assert fs and any("line_len" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_log_schema_detects_field_order_drift(tmp_path):
+    py = _mutated(tmp_path, LOG_PY, '("level", 1),\n    ("source", 1),',
+                  '("source", 1),\n    ("level", 1),', "graftlog.py")
+    fs = wire_schema.run_log(py, LOG_CC, "py", "cc")
+    assert fs and any("order" in f.message or "level" in f.message
+                      for f in fs), [f.render() for f in fs]
+
+
+def test_log_schema_detects_record_size_drift(tmp_path):
+    py = _mutated(tmp_path, LOG_PY, "LOG_RECORD_SIZE = 256",
+                  "LOG_RECORD_SIZE = 264", "graftlog.py")
+    fs = wire_schema.run_log(py, LOG_CC, "py", "cc")
+    assert fs and any("size" in f.message.lower() for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_log_schema_detects_struct_format_mismatch(tmp_path):
+    # "Ns" payload tokens must tokenize as one N-byte field; a format
+    # edited away from the declared widths is the classic silent shear.
+    py = _mutated(tmp_path, LOG_PY, 'struct.Struct("<BBHIQ32s12s196s")',
+                  'struct.Struct("<BBHIQ32s16s192s")', "graftlog.py")
+    fs = wire_schema.run_log(py, LOG_CC, "py", "cc")
+    assert fs, "format/width mismatch not detected"
+
+
+def test_log_schema_detects_magic_drift(tmp_path):
+    # The hex magic gates salvage of rings left by older runs — it
+    # must parse under int(x, 0), not the decimal-only kind regex.
+    cc = _mutated(tmp_path, LOG_CC, "kLogMagic = 0x474C4F31",
+                  "kLogMagic = 0x474C4F32", "log_core.h")
+    fs = wire_schema.run_log(LOG_PY, cc, "py", "cc")
+    assert fs and any("MAGIC" in f.message for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_log_schema_detects_ring_geometry_drift(tmp_path):
+    # Slot count sizes the mmap and the slot index mask on both sides;
+    # a one-sided resize makes salvage read past (or short of) the file.
+    py = _mutated(tmp_path, LOG_PY, "LOG_RING_SLOTS = 4096",
+                  "LOG_RING_SLOTS = 2048", "graftlog.py")
+    fs = wire_schema.run_log(py, LOG_CC, "py", "cc")
+    assert fs and any("RING_SLOTS" in f.message for f in fs), \
         [f.render() for f in fs]
 
 

@@ -116,11 +116,12 @@ def test_native_store_sanitizers():
                              cwd=os.path.abspath(CSRC),
                              capture_output=True, text=True, timeout=600)
         assert out.returncode == 0, (target, out.stdout + out.stderr)
-        # All six native suites run sanitized: the store sidecar,
+        # All seven native suites run sanitized: the store sidecar,
         # the graftrpc reactor, the graftcopy engine, the graftscope
         # ring buffers (whose drain-while-writing storm is the whole
         # point of running under TSAN), the graftshm arena
-        # (concurrent acquire/recycle hammer), AND the graftprof
-        # sampler (drain-while-sampling + stop/start races) each
-        # print their own ALL OK.
-        assert out.stdout.count("ALL OK") >= 6, (target, out.stdout)
+        # (concurrent acquire/recycle hammer), the graftprof
+        # sampler (drain-while-sampling + stop/start races), AND the
+        # graftlog crash-persistent ring (emit storm vs live drain)
+        # each print their own ALL OK.
+        assert out.stdout.count("ALL OK") >= 7, (target, out.stdout)
