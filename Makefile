@@ -1,7 +1,8 @@
 # Developer entry points. CI runs ci.sh (which includes `make lint`'s
 # invocation verbatim); these targets are the pieces, runnable alone.
 
-.PHONY: lint lint-native test fast native native-test bench-core
+.PHONY: lint lint-native test fast native native-test bench-core \
+	bench-load
 
 # graftlint: framework-aware static analysis (event-loop safety, lock
 # discipline, Python<->C wire-schema drift, RPC signature drift, leaks,
@@ -31,3 +32,13 @@ native-test:
 # (one JSON line per metric; compare vs_ref against BASELINE.md).
 bench-core:
 	JAX_PLATFORMS=cpu python bench_core.py | tee BENCH_CORE.json
+
+# graftload: open-loop macro-load (serve + data + train concurrently)
+# + chaos schedule (worker kill, node kill, replacement node) with
+# machine-checked SLO verdicts read from the observability planes.
+# One JSON row per workload / chaos action / verdict; exits non-zero
+# if any SLO fails. ~2 min on a laptop; the ~10s smoke profile runs in
+# tier-1 CI via tests/test_graftload.py.
+bench-load:
+	JAX_PLATFORMS=cpu python -m ray_tpu.cli soak --profile bench \
+		| tee BENCH_LOAD.json

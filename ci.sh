@@ -19,6 +19,9 @@ echo "=== static analysis ==="
 python -m ray_tpu.tools.lint
 
 echo "=== stage 1: fast suite ==="
+# Includes the graftload smoke soak (tests/test_graftload.py): every
+# PR drives serve+data+train open-loop against a 2-node cluster, kills
+# a worker mid-run, and asserts the SLO verdicts the planes report.
 python -m pytest tests/ -m fast -q
 
 echo "=== stage 2: slow suites (chunked) ==="
@@ -33,6 +36,10 @@ python -m pytest tests/test_serve_llm.py tests/test_tune.py \
 python -m pytest tests/test_ops.py tests/test_model_parallel.py \
     tests/test_autoscaler.py tests/test_jobs_util.py \
     tests/test_runtime_env_container.py -q
+# Full graftload soak: two worker-kill rounds + node kill + replacement
+# node under sustained open-loop load (explicitly @slow inside an
+# otherwise-fast module, so it lands here and not in stage 1).
+python -m pytest tests/test_graftload.py -m slow -q
 
 echo "=== native-plane sanitizers ==="
 # make tsan / make asan via the pytest wrapper: store sidecar, graftrpc

@@ -10,8 +10,10 @@ Analogue of the reference's CLI (reference: python/ray/scripts/scripts.py
     python -m ray_tpu.cli list tasks --state FAILED --node ID ...
     python -m ray_tpu.cli summary tasks --address ...
     python -m ray_tpu.cli get task ID --address ...
-    python -m ray_tpu.cli audit --address ...
+    python -m ray_tpu.cli audit --address ... [--json]
     python -m ray_tpu.cli timeline --address ... --out trace.json
+    python -m ray_tpu.cli timeline --address ... --native --format chrome
+    python -m ray_tpu.cli soak --profile smoke|bench|full
     python -m ray_tpu.cli stack --address ... [--profile N]
     python -m ray_tpu.cli prof top --address ... [--task F] [--seconds N]
     python -m ray_tpu.cli prof flame --address ... -o out.json|out.collapsed
@@ -225,6 +227,12 @@ def cmd_audit(args) -> int:
     _connect(args.address)
     from ray_tpu import state
     report = state.audit(args.grace)
+    if getattr(args, "json", False):
+        # Machine surface: the full report, one JSON object — what the
+        # graftload verdict engine and external harnesses consume
+        # (exit code still carries pass/fail).
+        print(json.dumps(report, default=str))
+        return 0 if report["ok"] else 1
     s = report["stats"]
     print(f"tasks {s['tasks']} ({s.get('tasks_by_state', {})}) · "
           f"objects {s['objects']} ({s['objects_live']} live) · "
@@ -249,11 +257,36 @@ def cmd_audit(args) -> int:
 def cmd_timeline(args) -> int:
     _connect(args.address)
     from ray_tpu import state
-    trace = state.timeline(args.out, native=args.native)
+    fmt = getattr(args, "format", "events")
+    trace = state.timeline(args.out, native=args.native, fmt=fmt)
     n_native = sum(1 for ev in trace if ev.get("cat") == "native")
     extra = f" ({n_native} native spans)" if args.native else ""
-    print(f"wrote {len(trace)} trace events to {args.out}{extra}")
+    shape = " [chrome trace-event format]" if fmt == "chrome" else ""
+    print(f"wrote {len(trace)} trace events to {args.out}{extra}{shape}")
     return 0
+
+
+def cmd_soak(args) -> int:
+    """graftload: open-loop macro-load + chaos soak with machine-
+    checked SLO verdicts from the observability planes. Spins up its
+    own multi-node-in-one-box cluster (no --address), drives Serve +
+    Data + Train concurrently while the chaos schedule kills workers/
+    nodes, then prints one JSON row per workload/chaos-action/verdict
+    (`make bench-load` tees stdout into BENCH_LOAD.json). Exit 0 only
+    if every SLO verdict passed."""
+    from ray_tpu.load import scenario, soak
+    spec = scenario.profile(args.profile, duration_s=args.duration,
+                            seed=args.seed)
+    if args.nodes:
+        spec.nodes = args.nodes
+    result = soak.run_soak(spec)
+    if args.out:
+        with open(args.out, "w") as f:
+            for row in result["rows"]:
+                f.write(json.dumps(row, default=str) + "\n")
+        print(f"wrote {len(result['rows'])} rows to {args.out}",
+              file=sys.stderr)
+    return 0 if result["ok"] else 1
 
 
 def _print_folded(folded: dict, indent: str = "  ") -> None:
@@ -324,6 +357,9 @@ def cmd_prof(args) -> int:
     if args.action == "top":
         top = state.prof_top(limit=args.limit, **filt)
         total = top.get("total_samples", 0)
+        if getattr(args, "json", False):
+            print(json.dumps(top, default=str))
+            return 0 if total else 1
         if not total:
             print("no profile samples matched (is graftprof on? "
                   "RAY_TPU_GRAFTPROF=0 disables it)")
@@ -409,9 +445,17 @@ def cmd_logs(args) -> int:
                                node=args.node, level=level,
                                after_id=after_id, limit=limit)
 
+    as_json = getattr(args, "json", False)
+
+    def emit(r: dict) -> None:
+        # --json: one JSON object per line (JSONL) — follow mode
+        # streams machine-parseable rows too.
+        print(json.dumps(r, default=str) if as_json
+              else _fmt_log_row(r), flush=args.follow)
+
     rows = fetch(0, args.tail)
     for r in rows:
-        print(_fmt_log_row(r))
+        emit(r)
     if not args.follow:
         if not rows:
             print("no log records matched (is graftlog on? "
@@ -424,7 +468,7 @@ def cmd_logs(args) -> int:
             _t.sleep(max(0.1, args.interval))
             new = fetch(last, 1000)
             for r in new:
-                print(_fmt_log_row(r), flush=True)
+                emit(r)
             if new:
                 last = new[-1]["id"]
     except KeyboardInterrupt:
@@ -571,6 +615,9 @@ def main(argv=None) -> int:
     sp.add_argument("--grace", type=float, default=None,
                     help="seconds a non-terminal task may sit without a "
                          "transition before it counts as lost")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON object "
+                         "(machine surface; exit code still pass/fail)")
     sp.set_defaults(fn=cmd_audit)
 
     sp = sub.add_parser("stack", help="dump worker Python stacks "
@@ -596,6 +643,9 @@ def main(argv=None) -> int:
                          "(default: merged per-task history)")
     sp.add_argument("--limit", type=int, default=30,
                     help="top: max rows")
+    sp.add_argument("--json", action="store_true",
+                    help="top: emit rows as one JSON object instead of "
+                         "the ANSI table")
     sp.add_argument("-o", "--out", default=None,
                     help="flame: output path — .json (d3-flamegraph) "
                          "or .collapsed (flamegraph.pl input)")
@@ -616,6 +666,9 @@ def main(argv=None) -> int:
                     help="keep polling for new records")
     sp.add_argument("--interval", type=float, default=1.0,
                     help="poll period for --follow, seconds")
+    sp.add_argument("--json", action="store_true",
+                    help="emit records as JSONL (one JSON object per "
+                         "line; works with -f)")
     sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("timeline")
@@ -625,7 +678,28 @@ def main(argv=None) -> int:
                     help="include graftscope native-plane spans "
                          "(dispatch/wire/sidecar/copy) stitched under "
                          "their submitting tasks")
+    sp.add_argument("--format", choices=["events", "chrome"],
+                    default="events",
+                    help="chrome: Chrome trace-event JSON "
+                         "({traceEvents: [...]} with integer pid/tid + "
+                         "name metadata) — opens directly in Perfetto")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("soak", help="open-loop macro-load + chaos "
+                        "soak with SLO verdicts from the planes "
+                        "(graftload; spins up its own cluster)")
+    sp.add_argument("--profile", choices=["smoke", "bench", "full"],
+                    default="smoke")
+    sp.add_argument("--duration", type=float, default=None,
+                    help="load window seconds (default: per profile)")
+    sp.add_argument("--seed", type=int, default=None,
+                    help="arrival-schedule seed (default: per profile)")
+    sp.add_argument("--nodes", type=int, default=0,
+                    help="override node count")
+    sp.add_argument("-o", "--out", default=None,
+                    help="also write the JSON rows to this file "
+                         "(rows always stream to stdout)")
+    sp.set_defaults(fn=cmd_soak)
 
     sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     sp.add_argument("--address", required=True)

@@ -171,6 +171,13 @@ class Controller:
         # Tails that found no record to join wait here and re-attach on
         # the next trail fold (or at query time).
         self._pending_task_logs: Dict[str, list] = {}
+        # graftload: the live status blob a running soak pushes at 1 Hz
+        # (report_soak). Rides the /api/cluster telemetry view so the
+        # dashboard shows the soak while it hammers the cluster; staled
+        # out after _SOAK_STALE_S so a crashed generator doesn't leave a
+        # ghost panel.
+        self._soak_status: Dict[str, Any] = {}
+        self._soak_rx_mono: float = 0.0
         # Infeasible-demand signals, coalesced BY SHAPE (a parked lease
         # retries pick_node every ~250ms; raw per-attempt records would
         # multiply one pending task into dozens of demands and stampede
@@ -327,7 +334,10 @@ class Controller:
         self.node_metrics[node_id.hex()[:12]] = snapshot
 
     async def get_metrics(self) -> dict:
-        return self.node_metrics
+        # Shallow-copy: the reply must be a point-in-time snapshot even
+        # if a report_metrics ingest lands between handler return and
+        # serialisation (dashboard handlers poll this concurrently).
+        return dict(self.node_metrics)
 
     async def metrics_text(self) -> str:
         """Prometheus text exposition over every node's registry."""
@@ -340,6 +350,16 @@ class Controller:
         version-skewed agent must not kill the controller); a good pulse
         also clears any suspect state the cadence FSM set."""
         self.pulse.ingest(node_id.hex()[:12], blob)
+
+    _SOAK_STALE_S = 30.0
+
+    async def report_soak(self, status: dict) -> None:
+        """graftload ingest: the soak generator's 1 Hz status blob
+        (phase, per-workload submit/complete counts, chaos log). Kept
+        as one opaque dict — the soak owns its schema; the controller
+        only stamps receipt time for staleness."""
+        self._soak_status = dict(status)
+        self._soak_rx_mono = time.monotonic()
 
     async def cluster_telemetry(self, window: int = 30) -> dict:
         """The cluster SLO view: per-op p50/p99 + throughput folded over
@@ -376,6 +396,9 @@ class Controller:
                     "health": "no-pulse", "addr": list(n.addr),
                     "state": str(n.state),
                 }
+        if self._soak_status and (time.monotonic() - self._soak_rx_mono
+                                  <= self._SOAK_STALE_S):
+            snap["soak"] = dict(self._soak_status)
         return snap
 
     async def cluster_metrics_text(self) -> str:
@@ -507,19 +530,30 @@ class Controller:
         node, every sealed object freed or still resident where the
         ledger says. Resident oid sets come from the alive agents
         (best-effort — an unreachable agent's node is skipped rather
-        than reported as a mass leak)."""
-        alive = {n.node_id.hex()[:12] for n in self.nodes.values()
-                 if n.state == NodeState.ALIVE}
+        than reported as a mass leak).
+
+        Consistency: the resident RPCs fan out CONCURRENTLY and the
+        alive-node set is computed AFTER they land, in the same event-
+        loop slice as the ledger walk. The old shape (alive set first,
+        then serial 2s-timeout awaits per node) let membership fold
+        mid-audit under chaos: a node going DEAD between the snapshot
+        and the walk surfaced as a raft of phantom "lost" tasks."""
+        nodes = self._alive_nodes()
+        results = await asyncio.gather(
+            *(asyncio.wait_for(n.client.call("trail_residents"),
+                               timeout=2.0) for n in nodes),
+            return_exceptions=True)
         residents: Dict[str, set] = {}
-        for node in self._alive_nodes():
-            try:
-                oids = await asyncio.wait_for(
-                    node.client.call("trail_residents"), timeout=2.0)
-                residents[node.node_id.hex()[:12]] = set(oids)
-            except Exception:
-                pass  # skip: absence of ground truth is not a leak
+        for node, oids in zip(nodes, results):
+            if isinstance(oids, BaseException):
+                continue  # skip: absence of ground truth is not a leak
+            residents[node.node_id.hex()[:12]] = set(oids)
         if grace_s is None:
             grace_s = GlobalConfig.trail_audit_grace_s
+        # No awaits below: alive set + ledger walk see one point-in-
+        # time membership table.
+        alive = {n.node_id.hex()[:12] for n in self.nodes.values()
+                 if n.state == NodeState.ALIVE}
         return self.trail.audit(alive, residents=residents,
                                 grace_s=grace_s)
 

@@ -15,8 +15,11 @@ React client is out of scope). Endpoints:
     GET /api/state/audit   -> conservation audit report
     GET /api/timeline    -> Chrome-trace JSON incl. graftscope native spans
     GET /api/native      -> native hot-path latency rollup (graftscope)
-    GET /api/cluster     -> graftpulse SLO view (per-op p50/p99, per-node
-                            occupancy + pulse health, resident totals)
+    GET /api/cluster?window=N
+                         -> graftpulse SLO view (per-op p50/p99 over the
+                            last N pulses per node, per-node occupancy +
+                            pulse health, resident totals; a running
+                            graftload soak's live status rides along)
     GET /api/logs?task=&actor=&node=&level=30&tail=N&after_id=&stats=1
                          -> graftlog cluster log records (crash-
                             persistent rings; salvaged tails included)
@@ -64,7 +67,8 @@ _PAGE = """<!doctype html>
 <h3>Task summary</h3><table id="tasks"></table>
 <h3>Native hot paths (graftscope)</h3><table id="native"></table>
 <h3>Cluster telemetry (graftpulse)</h3>
-<div id="pulse" class="muted"></div><table id="cluster"></table>
+<div id="pulse" class="muted"></div>
+<div id="soak" class="muted"></div><table id="cluster"></table>
 <h3>Jobs</h3><table id="jobs"></table>
 <p class="muted">raw: <a href="/api/summary">summary</a> ·
 <a href="/api/nodes">nodes</a> · <a href="/api/actors">actors</a> ·
@@ -135,6 +139,17 @@ async function tick() {
        tot.queue_depth ?? 0} · workers ${tot.num_workers ?? 0} · ` +
       `store ${fmt((tot.store_used ?? 0) / 1048576)}MiB · ` +
       `window ${fmt(cluster.window_s ?? 0)}s`;
+    const soak = cluster.soak, soakEl = document.getElementById("soak");
+    if (soak) {
+      const wl = Object.entries(soak.workloads || {}).map(([k, v]) =>
+        `${k} ${v.completed}/${v.submitted}` +
+        (v.errors ? ` (${v.errors} err)` : "")).join(" · ");
+      const chaos = (soak.chaos || []).map(c =>
+        `${c.kind}@${c.at_s}s${c.ok ? "" : " FAILED"}`).join(", ");
+      soakEl.innerHTML = `<b>soak ${soak.profile}</b> [${soak.phase}] ` +
+        `${soak.elapsed_s}/${soak.duration_s}s · ${wl}` +
+        (chaos ? ` · chaos: ${chaos}` : "");
+    } else soakEl.textContent = "";
     table("cluster",
       Object.entries(cluster.ops || {}).map(([op, v]) => ({op, ...v})),
       ["op","calls","p50_ns","p99_ns","calls_per_s","bytes_per_s"],
@@ -331,6 +346,15 @@ class _Handler(BaseHTTPRequestHandler):
                     state.audit(float(grace) if grace else None),
                     default=str).encode())
                 return
+            if path == "/api/cluster":
+                # graftpulse SLO view; ?window=N bounds how many recent
+                # pulses per node feed the aggregates (verdict engines
+                # want "p99 over the last N ticks", not all-time). The
+                # soak status blob rides along while a soak runs.
+                self._send(200, json.dumps(state.cluster_telemetry(
+                    window=int(q.get("window", 30) or 30)),
+                    default=str).encode())
+                return
             routes = {
                 "/api/summary": state.cluster_summary,
                 "/api/nodes": state.list_nodes,
@@ -339,7 +363,6 @@ class _Handler(BaseHTTPRequestHandler):
                 "/api/workers": state.list_workers,
                 "/api/timeline": state.timeline,
                 "/api/native": state.native_latency,
-                "/api/cluster": state.cluster_telemetry,
             }
             if path == "/api/jobs":
                 from ray_tpu import job_submission

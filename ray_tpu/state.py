@@ -136,6 +136,13 @@ def cluster_telemetry(window: int = 30) -> dict:
     return _ctl("cluster_telemetry", window)
 
 
+def report_soak(status: dict) -> None:
+    """Push a running soak's status blob to the controller (graftload's
+    1 Hz reporter). Shows up as `soak` in cluster_telemetry() / the
+    dashboard /api/cluster view while fresh."""
+    _ctl("report_soak", status)
+
+
 def cluster_metrics_text() -> str:
     """Federated Prometheus exposition: every node's registry plus the
     pulse-derived raytpu_cluster_* aggregates (served at
@@ -151,20 +158,58 @@ def native_latency() -> List[dict]:
 
 
 def timeline(filename: Optional[str] = None,
-             native: bool = True) -> List[dict]:
+             native: bool = True, fmt: str = "events") -> List[dict]:
     """Chrome-trace events for every recorded task — plus, with
     ``native`` (default), the graftscope native-plane spans (dispatch,
     wire, sidecar service, copy) nested under the submitting task. Pass
     filename to dump JSON loadable in chrome://tracing / Perfetto
     (reference: `ray timeline`). The dump is atomic (tmp + rename): a
-    crash or concurrent reader never sees a torn file."""
+    crash or concurrent reader never sees a torn file.
+
+    fmt="chrome" writes the Chrome trace-event FORMAT object
+    ({"traceEvents": [...]} with integer pid/tid plus process_name/
+    thread_name metadata) instead of the raw event array — the shape
+    Perfetto's UI ingests directly. The returned value is always the
+    raw event list."""
     trace = _ctl("timeline", native)
     if filename:
+        payload = to_chrome_trace(trace) if fmt == "chrome" else trace
         tmp = filename + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(trace, f)
+            json.dump(payload, f)
         os.replace(tmp, filename)
     return trace
+
+
+def to_chrome_trace(events: List[dict]) -> dict:
+    """Convert the raw timeline event array to Chrome trace-event
+    format: integer pid/tid (the controller emits string track names),
+    "M" metadata events naming each process/thread, and the
+    {"traceEvents": ...} envelope chrome://tracing and Perfetto expect.
+    Pure function — unit-testable without a cluster."""
+    pids: Dict[str, int] = {}
+    tids: Dict[tuple, int] = {}
+    out: List[dict] = []
+    meta: List[dict] = []
+    for ev in events:
+        pname, tname = str(ev.get("pid", "?")), str(ev.get("tid", "?"))
+        if pname not in pids:
+            pids[pname] = len(pids) + 1
+            meta.append({"name": "process_name", "ph": "M",
+                         "pid": pids[pname], "tid": 0,
+                         "args": {"name": pname}})
+        pid = pids[pname]
+        tkey = (pname, tname)
+        if tkey not in tids:
+            tids[tkey] = len(tids) + 1
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": pid, "tid": tids[tkey],
+                         "args": {"name": tname}})
+        row = dict(ev)
+        row["pid"] = pid
+        row["tid"] = tids[tkey]
+        out.append(row)
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
 
 
 def stack(node_id: Optional[str] = None,

@@ -63,7 +63,10 @@ def pytest_collection_modifyitems(config, items):
         module = item.nodeid.split("::")[0].rsplit("/", 1)[-1][:-3]
         if module in _SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
-        else:
+        elif item.get_closest_marker("slow") is None:
+            # Respect an explicit @pytest.mark.slow inside an otherwise
+            # fast module (e.g. the full graftload soak): adding `fast`
+            # on top would pull it into the `-m fast` CI stage.
             item.add_marker(pytest.mark.fast)
 
 

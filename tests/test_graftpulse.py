@@ -123,6 +123,30 @@ def test_aggregator_folds_nodes_and_drops_garbage():
     assert agg.total_queue_depth() == 2
 
 
+def test_aggregator_window_bounds_aggregates():
+    """snapshot(window=N) folds only the last N pulses per node — the
+    contract behind /api/cluster?window=N and the soak verdict's
+    recent-window p99."""
+    agg = graftpulse.ClusterAggregator(history=20)
+    k = {"rpc_send": (1, 100, 1_000, _hist(b0=1))}
+    for seq in range(1, 11):
+        agg.ingest("aaa", graftpulse.encode(
+            _pulse(seq=seq, t_mono_ns=seq * 10**9, kinds=k)))
+    assert agg.snapshot(window=3)["ops"]["rpc_send"]["calls"] == 3
+    assert agg.snapshot(window=10)["ops"]["rpc_send"]["calls"] == 10
+    # window=0 means "everything retained" (bounded by history).
+    assert agg.snapshot(window=0)["ops"]["rpc_send"]["calls"] == 10
+    # An over-long window clamps to what exists, with the span to match.
+    snap = agg.snapshot(window=500)
+    assert snap["ops"]["rpc_send"]["calls"] == 10
+    assert snap["window_s"] == pytest.approx(9.0)
+    assert agg.snapshot(window=3)["window_s"] == pytest.approx(2.0)
+    # A single-pulse window has no span and so no rates.
+    one = agg.snapshot(window=1)
+    assert one["window_s"] == 0.0
+    assert one["ops"]["rpc_send"]["calls_per_s"] == 0.0
+
+
 def test_assembler_emits_deltas_not_cumulatives(monkeypatch):
     from ray_tpu.core._native import graftscope
     calls = {"n": 0}
@@ -363,6 +387,13 @@ def test_dashboard_cluster_surfaces(pulse_cluster):
         for n in t["nodes"].values():
             assert n["health"] in ("alive", "suspect", "no-pulse")
         assert t["totals"]["num_workers"] >= 0
+        # ?window=N reaches the aggregator: a 1-pulse window has no
+        # span (and the handler reads its own consistent snapshot —
+        # same shape, no partial dict under concurrent pulse ingest).
+        t1 = json.load(urllib.request.urlopen(
+            f"{base}/api/cluster?window=1", timeout=10))
+        assert set(t1) == set(t)
+        assert t1["window_s"] == 0.0
         text = urllib.request.urlopen(f"{base}/metrics/cluster",
                                       timeout=10).read().decode()
         assert "raytpu_cluster_store_objects" in text

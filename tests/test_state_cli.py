@@ -73,6 +73,51 @@ def test_task_events_and_timeline(cluster, tmp_path):
     assert json.load(open(out))  # valid chrome-trace JSON
 
 
+def test_timeline_chrome_format(cluster, tmp_path):
+    """`timeline --native --format chrome` writes Chrome trace-event
+    JSON Perfetto can open: a {"traceEvents": [...]} envelope, integer
+    pid/tid, and process/thread name metadata carrying the original
+    node/worker labels."""
+    @ray_tpu.remote
+    def chrome_task(x):
+        return x + 1
+
+    ray_tpu.get([chrome_task.remote(i) for i in range(3)])
+    from ray_tpu import api
+    api._cw()._flush_task_events()
+
+    out = str(tmp_path / "chrome.json")
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        state.timeline(out, native=True, fmt="chrome")
+        doc = json.load(open(out))
+        named = [e for e in doc["traceEvents"]
+                 if e.get("name") == "chrome_task"]
+        if len(named) >= 3:
+            break
+        time.sleep(0.3)
+    assert isinstance(doc["traceEvents"], list)
+    assert len(named) >= 3, "chrome_task slices missing from trace"
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["name"] for m in meta} >= {"process_name", "thread_name"}
+    for ev in doc["traceEvents"]:
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+
+    # Same through the CLI (the user-facing path).
+    from ray_tpu import api as _api
+    host, port = _api._cw().controller_addr
+    cli_out = str(tmp_path / "cli_chrome.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.cli", "timeline",
+         "--address", f"{host}:{port}", "--native",
+         "--format", "chrome", "--out", cli_out],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "chrome trace-event format" in r.stdout
+    doc = json.load(open(cli_out))
+    assert {e["ph"] for e in doc["traceEvents"]} >= {"M", "X"}
+
+
 def test_metrics_pipeline(cluster):
     from ray_tpu.utils.config import GlobalConfig
     deadline = time.monotonic() + 3 * (
