@@ -1,15 +1,22 @@
 # Developer entry points. CI runs ci.sh (which includes `make lint`'s
 # invocation verbatim); these targets are the pieces, runnable alone.
 
-.PHONY: lint lint-native test fast native native-test bench-core \
-	bench-load
+.PHONY: lint lint-hotpath lint-native test fast native native-test \
+	bench-core bench-load
 
 # graftlint: framework-aware static analysis (event-loop safety, lock
 # discipline, Python<->C wire-schema drift, RPC signature drift, leaks,
-# store-protocol state machine, csrc memory orders + error-path fds).
+# store-protocol state machine, csrc memory orders + error-path fds,
+# hot-path round-trip budgets).
 #   python -m ray_tpu.tools.lint --list-passes   for the pass list
 lint:
 	python -m ray_tpu.tools.lint
+
+# Just the hot-path budget pass (4d) — ~0.4s; the one to re-run in a
+# tight loop while editing core_worker.py / api.py hot paths. The
+# derived per-op cost table: python -m ray_tpu.tools.lint --costs
+lint-hotpath:
+	python -m ray_tpu.tools.lint --hotpath-only
 
 # Just the native-plane passes (4b memory-order, 4c fd-leak) — the ones
 # to re-run in a tight loop while editing csrc/.

@@ -8,14 +8,25 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 echo "=== static analysis ==="
-# graftlint: event-loop safety, lock discipline, Python<->C wire-schema
-# drift (store 3a, graftrpc 3c, ctypes 3d, graftscope 3e, graftpulse 3f
-# incl. the version->size registry, graftprof 3g, graftlog 3h incl. the
-# char[] payload widths and the ring file magic), RPC handler-signature
-# drift, task/coroutine leaks — plus the graftgate passes: store-protocol
-# state machine vs tools/lint/protocol.json (4a), csrc memory-order
-# discipline (4b), error-path fd/inode leaks (4c). First gate: nothing
-# else runs if this fails.
+# graftpath first (~0.4s): whole-program hot-path round-trip analysis
+# vs tools/lint/budgets.json (pass 4d). Every public hot-path op
+# (submit/call/put/get/ref-drop/pg) has a machine-checked per-op cost
+# vector — controller/agent/sidecar round-trips, deferred sends,
+# executor hops — and the committed artifact must equal the derived
+# tree EXACTLY (cheaper means tighten the budget, dearer is a hot-path
+# regression), so a control-plane perf regression fails CI before a
+# single test runs instead of surfacing as a BENCH_CORE delta later.
+python -m ray_tpu.tools.lint --hotpath-only
+# graftlint (full): event-loop safety, lock discipline, Python<->C
+# wire-schema drift (store 3a, graftrpc 3c, ctypes 3d, graftscope 3e,
+# graftpulse 3f incl. the version->size registry, graftprof 3g,
+# graftlog 3h incl. the char[] payload widths and the ring file magic),
+# RPC handler-signature drift, task/coroutine leaks — plus the
+# graftgate passes: store-protocol state machine vs
+# tools/lint/protocol.json (4a), csrc memory-order discipline (4b),
+# error-path fd/inode leaks (4c), and the hot-path budgets again as
+# part of the single-parse run (4d). Gate: nothing else runs if this
+# fails.
 python -m ray_tpu.tools.lint
 
 echo "=== stage 1: fast suite ==="

@@ -204,6 +204,59 @@ uint64_t log_emit(int level, int source, const char* task,
   return h + 1;
 }
 
+uint64_t log_emit_batch(int level, int source, const char* task,
+                        const char* actor, const char* lines, int len) {
+  if (!log_enabled()) return 0;
+  if (lines == nullptr || len <= 0) return 0;
+  SpinGuard g(g_emit_lock);
+  if (g_hdr == nullptr) {
+    // Count the would-be records so the loss is visible.
+    uint64_t n = 1;
+    for (int i = 0; i < len; i++) n += lines[i] == '\n';
+    g_dropped.fetch_add(n, std::memory_order_relaxed);
+    return 0;
+  }
+  uint64_t t_ns = WallNs();
+  char task_pad[kLogTaskCap], actor_pad[kLogActorCap];
+  CopyPadded(task_pad, kLogTaskCap, task);
+  CopyPadded(actor_pad, kLogActorCap, actor);
+  uint8_t lvl = (uint8_t)(level < 0 ? 0 : level > 255 ? 255 : level);
+  uint64_t h = __atomic_load_n(&g_hdr->head, __ATOMIC_RELAXED);
+  const char* p = lines;
+  const char* end = lines + len;
+  while (p < end) {
+    const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+    int mlen = (int)((nl != nullptr ? nl : end) - p);
+    if (mlen > 0) {
+      LogWireRec* rec =
+          (LogWireRec*)(g_base +
+                        (size_t)(h & (kLogRingSlots - 1)) *
+                            kLogRecordSize);
+      rec->level = lvl;
+      rec->source = (uint8_t)(source & 0xff);
+      rec->line_len = (uint16_t)(mlen > 0xffff ? 0xffff : mlen);
+      rec->seq = (uint32_t)(h + 1);
+      rec->t_ns = t_ns;
+      memcpy(rec->task, task_pad, kLogTaskCap);
+      memcpy(rec->actor, actor_pad, kLogActorCap);
+      int n = mlen > kLogMsgCap ? kLogMsgCap : mlen;
+      memcpy(rec->msg, p, (size_t)n);
+      if (n < kLogMsgCap)
+        memset(rec->msg + n, 0, (size_t)(kLogMsgCap - n));
+      h++;
+    }
+    p = nl != nullptr ? nl + 1 : end;
+  }
+  uint64_t h0 = __atomic_load_n(&g_hdr->head, __ATOMIC_RELAXED);
+  if (h == h0) return 0;  // batch was all empty lines
+  // One publish for the whole batch: every record's bytes land before
+  // the head moves, so a reader that observes the new head sees whole
+  // records — same discipline as the single-record emit.
+  __atomic_store_n(&g_hdr->head, h, __ATOMIC_RELEASE);
+  g_hdr->dropped = g_dropped.load(std::memory_order_relaxed);
+  return h;
+}
+
 int log_enabled(void) {
   int e = g_enabled.load(std::memory_order_relaxed);
   return e < 0 ? ResolveEnabled() : e;

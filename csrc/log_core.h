@@ -87,6 +87,16 @@ void log_ring_close(void);
 uint64_t log_emit(int level, int source, const char* task,
                   const char* actor, const char* msg, int msg_len);
 
+// Append a '\n'-separated batch of lines as consecutive records under
+// one lock acquisition, one wall-clock read and one head publish —
+// the stdio tee flushes its per-quantum line buffer through this
+// instead of paying an FFI call per printed line. All records share
+// level/source/task/actor; empty lines are skipped. Returns the seq
+// of the last record appended, or 0 when disabled / not open / the
+// batch held no non-empty lines.
+uint64_t log_emit_batch(int level, int source, const char* task,
+                        const char* actor, const char* lines, int len);
+
 // 1 while emitting. Default comes from RAY_TPU_GRAFTLOG (unset/1 = on,
 // "0"/"false"/"off"/"no" = off), resolved once on first use.
 int log_enabled(void);

@@ -153,6 +153,37 @@ int TestFileDecode() {
   return 0;
 }
 
+int TestEmitBatch() {
+  Drain();
+  uint64_t base = log_emitted();
+  // Mixed batch: empty lines (doubled \n, trailing \n) are skipped,
+  // the rest land as consecutive records sharing one timestamp.
+  uint64_t h = log_emit_batch(20, kLogSrcStdout, "feed", "beef",
+                              "alpha\n\nbravo\ncharlie\n", 21);
+  CHECK(h == base + 3);
+  auto recs = Drain();
+  CHECK(recs.size() == 3);
+  CHECK(Field(recs[0].msg, kLogMsgCap) == "alpha");
+  CHECK(Field(recs[1].msg, kLogMsgCap) == "bravo");
+  CHECK(Field(recs[2].msg, kLogMsgCap) == "charlie");
+  CHECK(recs[0].t_ns == recs[2].t_ns);
+  CHECK(recs[0].seq == (uint32_t)(base + 1));
+  CHECK(recs[2].seq == (uint32_t)(base + 3));
+  for (const LogWireRec& r : recs) {
+    CHECK(r.level == 20 && r.source == kLogSrcStdout);
+    CHECK(Field(r.task, kLogTaskCap) == "feed");
+    CHECK(Field(r.actor, kLogActorCap) == "beef");
+  }
+  // No final newline: the tail still counts as a line.
+  CHECK(log_emit_batch(20, kLogSrcStdout, "", "", "tail", 4) ==
+        base + 4);
+  CHECK(Drain().size() == 1);
+  // All-empty batch appends nothing.
+  CHECK(log_emit_batch(20, kLogSrcStdout, "", "", "\n\n", 2) == 0);
+  CHECK(log_emitted() == base + 4);
+  return 0;
+}
+
 int TestWraparound() {
   Drain();
   uint64_t dropped0 = log_dropped();
@@ -264,6 +295,8 @@ int main() {
   std::printf("log roundtrip ok\n");
   rc |= TestFileDecode();
   std::printf("log file decode ok\n");
+  rc |= TestEmitBatch();
+  std::printf("log emit batch ok\n");
   rc |= TestWraparound();
   std::printf("log wraparound ok\n");
   rc |= TestDrainWhileWriting();

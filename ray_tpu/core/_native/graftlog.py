@@ -356,6 +356,34 @@ def emit(level: int, source: int, msg: str, task: Optional[str] = None,
     return _emit_now(level, source, task, actor, msg)
 
 
+def emit_batch(level: int, source: int, lines: List[str],
+               task: str = "", actor: str = "") -> int:
+    """Append a batch of same-context lines as consecutive records with
+    ONE FFI crossing (log_emit_batch joins on newline and fills slots
+    under a single lock acquisition / clock read). The stdio tee
+    flushes its per-quantum buffer through this — the per-line cost of
+    a print storm drops from one full emit() to a list append. Returns
+    the seq of the last record, or 0 when disabled / empty / pending.
+    Lines must not contain newlines (the tee's lines are split
+    products; a stray one would just split into extra records)."""
+    if not _enabled_fast() or not lines:
+        return 0
+    if _mode == "native" and _lib is not None:
+        raw = "\n".join(lines).encode("utf-8", "replace")
+        return int(_lib.log_emit_batch(int(level), int(source),
+                                       task.encode("ascii", "replace"),
+                                       actor.encode("ascii", "replace"),
+                                       raw, len(raw)))
+    if _mode is None:
+        for line in lines:
+            _pending.append((level, source, task, actor, line))
+        return 0
+    n = 0
+    for line in lines:
+        n = _emit_now(level, source, task, actor, line)
+    return n
+
+
 def emitted() -> int:
     lib = _get_lib()
     if _mode == "native" and lib is not None:
@@ -526,9 +554,21 @@ class _TeeStream:
     """Wraps sys.stdout/sys.stderr: every byte still reaches the
     original stream (the agent's pipe pump and driver echo are
     untouched); complete lines are additionally emitted to the ring
-    with the thread's task context."""
+    with the thread's task context.
+
+    Ring emits are BATCHED per flush quantum: lines buffer as
+    (task, actor) context runs and ship through emit_batch (one FFI
+    crossing per run) when the buffer reaches _FLUSH_LINES, the oldest
+    buffered line ages past _FLUSH_NS, or flush() is called — the
+    worker flushes at task completion and on the telemetry tick, so a
+    task's lines are ring-visible by the time its result is. WARNING+
+    streams (stderr) bypass the buffer entirely: tracebacks and last
+    words are the crash-forensics payload and must hit the
+    MAP_SHARED ring the moment they are written, not a quantum later."""
 
     _MAX_PARTIAL = 8192
+    _FLUSH_LINES = 64
+    _FLUSH_NS = 50_000_000  # 50ms
 
     def __init__(self, stream, source: int, level: int):
         self._stream = stream
@@ -536,10 +576,14 @@ class _TeeStream:
         self._level = level
         self._partial = ""
         self._lock = threading.Lock()
+        self._buf: List[tuple] = []  # (task, actor, [lines]) runs
+        self._buf_n = 0
+        self._buf_ns = 0
 
     def write(self, s) -> int:
         n = self._stream.write(s)
         try:
+            batch = None
             with self._lock:
                 self._partial += s
                 if "\n" in self._partial or \
@@ -548,23 +592,54 @@ class _TeeStream:
                     if len(self._partial) > self._MAX_PARTIAL:
                         lines.append(self._partial)
                         self._partial = ""
-                else:
-                    lines = []
-            for line in lines:
-                if line:
-                    emit(self._level, self._source, line)
+                    lines = [ln for ln in lines if ln]
+                    if lines:
+                        ctx = _registry().get(threading.get_ident())
+                        task, actor = (ctx[0], ctx[1]) \
+                            if ctx is not None else ("", "")
+                        if self._buf and self._buf[-1][0] == task \
+                                and self._buf[-1][1] == actor:
+                            self._buf[-1][2].extend(lines)
+                        else:
+                            self._buf.append((task, actor, lines))
+                        if self._buf_n == 0:
+                            self._buf_ns = time.monotonic_ns()
+                        self._buf_n += len(lines)
+                        if (self._level >= logging.WARNING
+                                or self._buf_n >= self._FLUSH_LINES
+                                or time.monotonic_ns() - self._buf_ns
+                                >= self._FLUSH_NS):
+                            batch, self._buf, self._buf_n = \
+                                self._buf, [], 0
+            if batch:
+                for task, actor, run in batch:
+                    emit_batch(self._level, self._source, run,
+                               task, actor)
         except Exception:
             pass
         return n
 
     def flush(self) -> None:
         self._stream.flush()
+        self.flush_ring()
+
+    def flush_ring(self) -> None:
+        """Ship buffered lines to the ring without touching the
+        underlying stream (loop-safe: no blocking stream I/O)."""
+        try:
+            with self._lock:
+                batch, self._buf, self._buf_n = self._buf, [], 0
+            for task, actor, run in batch:
+                emit_batch(self._level, self._source, run, task, actor)
+        except Exception:
+            pass
 
     def __getattr__(self, name):
         return getattr(self._stream, name)
 
 
 _tee_installed = False
+_tees: List["_TeeStream"] = []
 
 
 def install_stdio_tee() -> None:
@@ -575,7 +650,16 @@ def install_stdio_tee() -> None:
         return
     sys.stdout = _TeeStream(sys.stdout, LOG_SRC_STDOUT, logging.INFO)
     sys.stderr = _TeeStream(sys.stderr, LOG_SRC_STDERR, logging.WARNING)
+    _tees[:] = [sys.stdout, sys.stderr]
     _tee_installed = True
+
+
+def flush_stdio_tee() -> None:
+    """Ship any tee-buffered lines to the ring. Called at task
+    completion and on the worker's telemetry tick so the batching
+    quantum never delays a finished task's lines past its result."""
+    for tee in _tees:
+        tee.flush_ring()
 
 
 # --- controller-side log store --------------------------------------------

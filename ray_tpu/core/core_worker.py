@@ -272,6 +272,12 @@ class CoreWorker:
         # Per-peer batched store frees (flushed on the next loop tick).
         self._free_buf: Dict[tuple, list] = {}
         self._free_flush_scheduled = False
+        # Deferred-ack puts: oid -> (sv, staged_path) until the sidecar's
+        # OP_PUT reply confirms adoption; failed acks queue here for
+        # loop-side repair through the spill-capable agent path.
+        self._put_unacked: Dict[bytes, tuple] = {}
+        self._put_ack_err: deque = deque()
+        self._put_drain_scheduled = False
         # Per-scheduling-class task-duration EMA: steers normal-task push
         # coalescing (slow tasks ship alone — a batch reply lands only
         # after every member executed).
@@ -636,8 +642,12 @@ class CoreWorker:
             pass  # observability is best-effort
 
     async def _task_event_flusher(self) -> None:
+        from ray_tpu.core._native import graftlog
         while True:
             await asyncio.sleep(2.0)
+            if self._put_unacked:
+                self._drain_put_reply()  # settle a burst-final put ack
+            graftlog.flush_stdio_tee()  # tee quantum backstop
             self._flush_task_events()
             self._flush_native_spans()
             self._flush_prof()
@@ -965,20 +975,25 @@ class CoreWorker:
         buf, self._free_buf = self._free_buf, {}
         local = tuple(self.agent_addr) if self.agent_addr else None
         for addr, oids in buf.items():
-            # Local frees ride the C sidecar (microseconds, no agent
-            # event-loop work; the journal keeps the agent's ledger
-            # authoritative). Remote frees stay RPC.
+            # Local frees ride the C sidecar as fire-and-forget OP_DROP
+            # sends (journaled like OP_DELETE; the agent's ledger stays
+            # authoritative) — a replied delete would park THIS event
+            # loop for a scheduler wake cycle per oid. The drops settle
+            # via the cumulative counters on later counter-carrying
+            # replies; the scratch callback keeps put-scratch recycling
+            # honest about each tenant's fate. Remote frees stay RPC.
             if addr == local:
                 fp = self._fastpath if self._fastpath_probed else None
                 if fp is not None:
                     try:
                         for oid in oids:
-                            fp.delete(oid)
+                            fp.drop_async(oid, self._scratch_note_delete)
                         continue
                     except OSError:
                         pass  # connection lost: fall through to RPC
             try:
                 peer = self._client_for_worker(addr)
+                # lint: allow(rpc-in-loop: one batched free_objects RPC per distinct peer node)
                 spawn(self._call_ignore_errors(peer, "free_objects", oids))
             except Exception:
                 pass
@@ -1541,21 +1556,49 @@ class CoreWorker:
         t1 = time.perf_counter_ns()
         phase["copy"] += t1 - t0
         path = os.path.join(sdir, name)
-        try:
-            rc = fp.put(oid, name, sv.total_size, len(meta))
-        except OSError:
-            # Sidecar died mid-put: orphaned staging file is swept by
-            # the agent; the loop path reconnects or RPCs.
-            self._drop_staged(path, oid)
-            return False
-        phase["ingest"] += time.perf_counter_ns() - t1
-        if rc == -1:
-            # Already stored: puts are idempotent — success, drop ours.
-            self._drop_staged(path, oid)
-        elif rc != 0:
-            # Full (-2) or rename failure: the RPC path can spill.
-            self._drop_staged(path, oid)
-            return False
+        total = sv.total_size + len(meta)
+        if (GlobalConfig.graftcopy_deferred_ack
+                and total < GlobalConfig.graftshm_min_bytes):
+            # Deferred ack: send the OP_PUT and move on — the sidecar
+            # processes requests in order, so the object is visible to
+            # every later op on this connection before the reply is
+            # even read. The ack rides the next client op (depth-1
+            # pipeline); a rejected adoption is repaired off-thread
+            # through the spill-capable agent path (_note_put_ack).
+            # Large puts keep the synchronous ack: their copy time
+            # dwarfs the round-trip, and a failed GiB adoption should
+            # not sit unacked in staging.
+            self._put_unacked[oid] = (sv, path)
+            try:
+                fp.put_deferred(oid, name, sv.total_size, len(meta),
+                                self._note_put_ack)
+            except OSError:
+                self._put_unacked.pop(oid, None)
+                self._drop_staged(path, oid)
+                return False
+            phase["ingest"] += time.perf_counter_ns() - t1
+            # No per-put drain wakeup: a burst's intermediate acks ride
+            # the next op's drain-before-send, the final one settles on
+            # the 2s task-event tick (or a getter's settle poke) — a
+            # call_soon_threadsafe here costs more in loop wakeups than
+            # the deferred reply saves.
+        else:
+            try:
+                rc = fp.put(oid, name, sv.total_size, len(meta))
+            except OSError:
+                # Sidecar died mid-put: orphaned staging file is swept
+                # by the agent; the loop path reconnects or RPCs.
+                self._drop_staged(path, oid)
+                return False
+            phase["ingest"] += time.perf_counter_ns() - t1
+            if rc == -1:
+                # Already stored: puts are idempotent — success, drop
+                # ours.
+                self._drop_staged(path, oid)
+            elif rc != 0:
+                # Full (-2) or rename failure: the RPC path can spill.
+                self._drop_staged(path, oid)
+                return False
         if asm is not None:
             # Put-plane spans carry the oid64 key AND the ambient trace
             # context: the controller learns oid64 -> context here and
@@ -1700,6 +1743,78 @@ class CoreWorker:
             self._scratch_freed.add(oid)
         else:
             self._scratch_stale.add(oid)
+
+    def _note_put_ack(self, oid: bytes, rc: int) -> None:
+        """Deferred put settled (runs under the fastpath client lock —
+        stays trivial). rc 0: adopted, done. Anything else queues for
+        loop-side repair: -1 already stored (drop our staging file),
+        -2/-3 full / io error (re-put through the agent, whose
+        admission can spill), -4 connection lost before the ack
+        (re-put; puts are idempotent either way)."""
+        if rc == 0:
+            self._put_unacked.pop(oid, None)
+            return
+        self._put_ack_err.append((oid, rc))
+        try:
+            self._loop.call_soon_threadsafe(self._process_put_acks)
+        except RuntimeError:
+            pass  # loop closed mid-shutdown
+
+    def _process_put_acks(self) -> None:
+        while self._put_ack_err:
+            oid, rc = self._put_ack_err.popleft()
+            staged = self._put_unacked.get(oid)
+            if staged is None:
+                continue
+            sv, path = staged
+            # Un-stage first in every case: for -1 the store kept its
+            # own copy; for the failures the un-adopted name would
+            # collide with the repair's restage (and if -4 actually
+            # adopted, the unlink fails harmlessly — the store's hex
+            # link holds the inode).
+            self._drop_staged(path, oid)
+            if rc == -1:
+                self._put_unacked.pop(oid, None)  # idempotent success
+                continue
+            spawn(self._repair_put(oid, sv))
+
+    async def _repair_put(self, oid: bytes, sv) -> None:
+        """Re-drive a deferred put whose ack reported failure. The
+        object was already READY to waiters — which stays true: the
+        repair re-stores the same immutable bytes, and local gets
+        issued meanwhile order behind the failed put on the shared
+        connection (they miss and land in _get_from_store, which waits
+        for this repair before declaring loss)."""
+        try:
+            await self._do_put(oid, sv)
+        except Exception as e:
+            self._mark_error(oid, WorkerCrashedError(
+                f"deferred put repair failed: {e!r}"))
+        finally:
+            self._put_unacked.pop(oid, None)
+
+    def _poke_put_drain(self) -> None:
+        """Make sure a put burst's LAST deferred ack is eventually
+        read even if no further client op comes along to drain it:
+        one coalesced loop callback per burst collects whatever reply
+        is still pending (by the time the loop runs it, the sidecar
+        answered long ago)."""
+        if self._put_drain_scheduled:
+            return
+        self._put_drain_scheduled = True
+        try:
+            self._loop.call_soon_threadsafe(self._drain_put_reply)
+        except RuntimeError:
+            self._put_drain_scheduled = False
+
+    def _drain_put_reply(self) -> None:
+        self._put_drain_scheduled = False
+        fp = self._fastpath if self._fastpath_probed else None
+        if fp is not None:
+            try:
+                fp.poll_pending()
+            except OSError:
+                pass  # connection lost: pending settled as -4
 
     def _scratch_try_write(self, sdir: str, path: str, oid: bytes,
                            total: int, sv, meta: bytes, fp) -> bool:
@@ -2248,6 +2363,16 @@ class CoreWorker:
     async def _get_from_store(self, oid: bytes, e: ObjectEntry,
                               priority: int = 0) -> Any:
         ok = await self._ensure_local(oid, list(e.locations), priority)
+        if not ok and oid in self._put_unacked:
+            # A deferred-ack put of this object hasn't settled — its
+            # OP_PUT may have failed (store full) with the repair
+            # still in flight. Wait for settlement, then look again
+            # before declaring the object lost.
+            self._poke_put_drain()
+            while oid in self._put_unacked:
+                await asyncio.sleep(0.002)
+            ok = await self._ensure_local(oid, list(e.locations),
+                                          priority)
         if not ok:
             # All copies lost: try lineage reconstruction.
             if e.creating_task is not None:
@@ -3442,6 +3567,7 @@ class CoreWorker:
                 if prepared is None:
                     sem.release()
                     continue
+                # lint: allow(rpc-in-loop: this loop IS the coalescer — one batched push per drained batch, inflight-bounded by the semaphore)
                 task = spawn(self._send_actor_batch(actor_id, *prepared))
                 task.add_done_callback(lambda _t, _s=sem: _s.release())
         finally:
@@ -3544,6 +3670,7 @@ class CoreWorker:
             t0 = time.monotonic()
             try:
                 try:
+                    # lint: allow(rpc-in-loop: retry loop — one batched push per attempt, not per item)
                     replies = await self._push_batch_transport(
                         actor_id, client, live)
                 finally:
@@ -3904,6 +4031,8 @@ class CoreWorker:
                     graftprof.clear_task_context()
                     _trace_local.ctx = None
                     self._exec_threads.pop(spec.task_id, None)
+                    from ray_tpu.core._native import graftlog
+                    graftlog.flush_stdio_tee()
 
             if spec.streaming:
                 return await self._execute_streaming(spec, user_fn)
@@ -3926,6 +4055,8 @@ class CoreWorker:
                 finally:
                     graftprof.clear_task_context()
                     _trace_ctxvar.reset(tok)
+                    from ray_tpu.core._native import graftlog
+                    graftlog.flush_stdio_tee()
             else:
                 result = await loop.run_in_executor(self._exec_pool, fn)
         except BaseException as e:  # user error -> error payload to owner
@@ -4055,6 +4186,8 @@ class CoreWorker:
                 graftprof.clear_task_context()
                 _trace_local.ctx = None
                 self._exec_threads.pop(spec.task_id, None)
+                from ray_tpu.core._native import graftlog
+                graftlog.flush_stdio_tee()
 
         try:
             # Async actors stream CONCURRENTLY (default thread pool): a

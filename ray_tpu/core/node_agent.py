@@ -511,46 +511,57 @@ class NodeAgent:
         from ray_tpu.utils import events as E
         asm = graftpulse.PulseAssembler()
         period = max(0.05, GlobalConfig.pulse_period_ms / 1000)
+        loop = asyncio.get_running_loop()
         while not self._shutdown:
             await asyncio.sleep(period)
             try:
-                free_b = free_slabs = 0
-                if self._fastpath is not None:
-                    # Executor hop: shm_stats crosses into the C sidecar
-                    # handle; keep the agent loop free of native calls.
-                    free_b, free_slabs, _ = await \
-                        asyncio.get_running_loop().run_in_executor(
-                            None, self._fastpath.shm_stats)
-                rss = sum(graftpulse.proc_rss_bytes(w.proc.pid)
-                          for w in self.workers.values()
-                          if w.proc.poll() is None)
-                # Drop scope blocks of departed workers so the
-                # assembler forgets their per-source cumulatives.
+                # Loop side: only in-memory snapshots (dict sizes, the
+                # scope block map, a waitpid poll per worker). The tick's
+                # real work — the sidecar shm_stats FFI, the /proc RSS
+                # scan, and the assembler's delta crunch — folds into
+                # ONE executor job, so a dispatch-adjacent tick costs the
+                # event loop one hop instead of an FFI call plus a file
+                # walk plus the assemble between every frame it pumps.
                 self._worker_scope = {
                     wid: blocks
                     for wid, blocks in self._worker_scope.items()
                     if wid in self.workers}
                 extra = {"w:" + wid.hex()[:12]: blocks
                          for wid, blocks in self._worker_scope.items()}
+                pids = [w.proc.pid for w in self.workers.values()
+                        if w.proc.poll() is None]
+                fp = self._fastpath
                 oncpu_pm, gil_pm = self._prof_permille()
-                pulse = asm.assemble(
-                    extra_sources=extra,
-                    store_used=self.store.used(),
-                    store_capacity=self.store.capacity(),
-                    store_objects=self.store.num_objects(),
-                    shm_free_chunks=free_slabs,
-                    shm_arena_bytes=free_b,
-                    num_workers=len(self.workers),
-                    queue_depth=len(self.leases)
-                    + len(self._lease_waiters),
-                    rss_bytes=rss,
-                    events_dropped=E.dropped_total(),
-                    prof_oncpu_permille=oncpu_pm,
-                    prof_gil_permille=gil_pm)
+                store_used = self.store.used()
+                store_capacity = self.store.capacity()
+                store_objects = self.store.num_objects()
+                num_workers = len(self.workers)
+                queue_depth = len(self.leases) + len(self._lease_waiters)
+                events_dropped = E.dropped_total()
+
+                def tick_job() -> bytes:
+                    free_b = free_slabs = 0
+                    if fp is not None:
+                        free_b, free_slabs, _ = fp.shm_stats()
+                    rss = sum(graftpulse.proc_rss_bytes(p) for p in pids)
+                    return graftpulse.encode(asm.assemble(
+                        extra_sources=extra,
+                        store_used=store_used,
+                        store_capacity=store_capacity,
+                        store_objects=store_objects,
+                        shm_free_chunks=free_slabs,
+                        shm_arena_bytes=free_b,
+                        num_workers=num_workers,
+                        queue_depth=queue_depth,
+                        rss_bytes=rss,
+                        events_dropped=events_dropped,
+                        prof_oncpu_permille=oncpu_pm,
+                        prof_gil_permille=gil_pm))
+
+                payload = await loop.run_in_executor(None, tick_job)
                 await asyncio.wait_for(
                     self.controller.call(
-                        "report_pulse", self.node_id.binary(),
-                        graftpulse.encode(pulse)),
+                        "report_pulse", self.node_id.binary(), payload),
                     timeout=max(period, 1.0))
             except asyncio.CancelledError:
                 raise

@@ -189,6 +189,10 @@ def _load_lib() -> ctypes.CDLL:
     lib.log_emit.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_char_p, ctypes.c_int]
+    lib.log_emit_batch.restype = ctypes.c_uint64
+    lib.log_emit_batch.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int]
     lib.log_enabled.restype = ctypes.c_int
     lib.log_enabled.argtypes = []
     lib.log_set_enabled.argtypes = [ctypes.c_int]
@@ -404,11 +408,17 @@ class FastStoreClient:
         self._drops: list = []
         self._drops_acked = 0   # cumulative server counters already
         self._erased_acked = 0  # applied (per connection)
+        # The one deferred-ack OP_PUT whose reply has not been read yet:
+        # (oid, callback) or None. Depth capped at 1 — every other op
+        # drains it first, so the reply stream can never interleave.
+        self._pending_put: Optional[tuple] = None
 
     def _fail_locked(self) -> None:
         # NEVER reuse a desynced connection: a partial write/read would
         # make the next op parse this op's stale reply. In-flight drops
-        # settle conservatively (rc 1: outcome unknown).
+        # settle conservatively (rc 1: outcome unknown); a pending
+        # deferred put settles as -4 (connection lost, outcome unknown
+        # — the caller repairs through the agent path).
         self._lib.store_client_close(self._fd)
         self._fd = -1
         self._expire_drops_locked()
@@ -421,6 +431,26 @@ class FastStoreClient:
         # Drop counters are per-connection on the server: start clean.
         self._expire_drops_locked()
 
+    def _drain_pending_locked(self) -> None:
+        """Collect the deferred put's reply before any other wire use.
+        Called at the top of EVERY op that touches the socket, so the
+        request/reply streams stay in lockstep (a CREATE's SCM_RIGHTS
+        fd, for instance, must never follow a stale queued reply)."""
+        if self._pending_put is None:
+            return
+        oid, cb = self._pending_put
+        ok = self._lib.store_client_recv(
+            self._fd, ctypes.byref(self._rc), ctypes.byref(self._ds),
+            ctypes.byref(self._ms), self._path, 4096)
+        if ok != 0:
+            self._fail_locked()
+        self._pending_put = None
+        # An OP_PUT reply carries the connection's cumulative drop
+        # counters, exactly like the synchronous put.
+        self._settle_drops_locked(self._ds.value, self._ms.value)
+        if cb is not None:
+            cb(oid, self._rc.value)
+
     def _expire_drops_locked(self) -> None:
         drops, self._drops = self._drops, []
         self._drops_acked = 0
@@ -428,6 +458,9 @@ class FastStoreClient:
         for oid, cb in drops:
             if cb is not None:
                 cb(oid, 1)
+        pending, self._pending_put = self._pending_put, None
+        if pending is not None and pending[1] is not None:
+            pending[1](pending[0], -4)
 
     def _settle_drops_locked(self, seen: int, erased: int) -> None:
         """Apply the cumulative drop counters a PUT/CONTAINS reply
@@ -460,6 +493,7 @@ class FastStoreClient:
         with self._lock:
             if self._fd < 0:  # previous transport error: reconnect once
                 self._reconnect_locked()
+            self._drain_pending_locked()
             ok = self._lib.store_client_request(
                 self._fd, op, oid, a, b, name, ctypes.byref(self._rc),
                 ctypes.byref(self._ds), ctypes.byref(self._ms),
@@ -486,6 +520,41 @@ class FastStoreClient:
         self._settle_drops(ds, ms)
         return rc
 
+    def put_deferred(self, oid: bytes, name: str, data_size: int,
+                     meta_size: int, cb=None) -> None:
+        """Deferred-ack graftcopy put: send the OP_PUT frame and return
+        without reading the reply. The server processes requests in
+        order on this connection, so the object is visible to every
+        later op the moment the sidecar reads the frame — only the
+        caller's ack is deferred. The reply (rc + cumulative drop
+        counters) is collected by the NEXT client op, which calls
+        `cb(oid, rc)` under the client lock (keep it trivial, never
+        call back into this client): rc 0 adopted, -1 already stored
+        (idempotent success; the caller unlinks its staging file),
+        -2/-3 store full / io error (the caller must re-put through a
+        spill-capable path), -4 connection lost before the ack (outcome
+        unknown; re-put is idempotent either way). At most ONE put is
+        in flight — a second put_deferred drains the first."""
+        with self._lock:
+            if self._fd < 0:
+                self._reconnect_locked()
+            self._drain_pending_locked()
+            # lint: allow(reply-path: deferred ack — the pending-put reply is read by _drain_pending_locked before any later recv, so the stream stays in sync)
+            ok = self._lib.store_client_send(
+                self._fd, self.OP_PUT, oid, data_size, meta_size,
+                name.encode())
+            if ok != 0:
+                self._fail_locked()
+            self._pending_put = (oid, cb)
+
+    def poll_pending(self) -> None:
+        """Collect a still-outstanding deferred-put reply, if any.
+        Called from the event loop after a put burst so the last ack
+        of the burst settles without waiting for the next client op."""
+        with self._lock:
+            if self._pending_put is not None and self._fd >= 0:
+                self._drain_pending_locked()
+
     def create(self, oid: bytes, data_size: int,
                meta_size: int) -> Tuple[int, str, int, int]:
         """graftshm CREATE: ask the sidecar for a store-owned slab and
@@ -499,6 +568,7 @@ class FastStoreClient:
         with self._lock:
             if self._fd < 0:
                 self._reconnect_locked()
+            self._drain_pending_locked()
             slab_fd = ctypes.c_int(-1)
             reused = ctypes.c_uint64()
             ok = self._lib.store_client_create(
@@ -547,6 +617,7 @@ class FastStoreClient:
         with self._lock:
             if self._fd < 0:
                 self._reconnect_locked()
+            self._drain_pending_locked()
             if len(self._drops) >= 64:
                 # Runaway guard (a caller that drops but never puts):
                 # one replied CONTAINS settles the backlog. The put
@@ -580,6 +651,7 @@ class FastStoreClient:
         with self._lock:
             if self._fd < 0:
                 self._reconnect_locked()
+            self._drain_pending_locked()
             ok = self._lib.store_client_request(
                 self._fd, self.OP_SCOPE, b"\x00" * 20, 0, 0, None,
                 ctypes.byref(self._rc), ctypes.byref(self._ds),
@@ -590,8 +662,14 @@ class FastStoreClient:
             return self._path.raw[:n], self._ds.value, bool(self._ms.value)
 
     def close(self) -> None:
-        if self._fd >= 0:
-            self._lib.store_client_close(self._fd)
+        with self._lock:
+            if self._fd >= 0:
+                self._lib.store_client_close(self._fd)
+                self._fd = -1
+            # In-flight drops and a pending deferred put settle
+            # conservatively (1 / -4): the process is letting go of the
+            # connection, their outcomes are unknowable.
+            self._expire_drops_locked()
             self._fd = -1
 
 

@@ -16,8 +16,8 @@ import os
 import sys
 from typing import List, Optional
 
-from ray_tpu.tools.lint import event_loop, leaks, locks, memorder, \
-    protocol, resource_paths, rpc_signatures, wire_schema
+from ray_tpu.tools.lint import event_loop, hotpath, leaks, locks, \
+    memorder, protocol, resource_paths, rpc_signatures, wire_schema
 from ray_tpu.tools.lint.common import (Finding, SourceFile, iter_py_files,
                                        load_allowlist, load_source)
 
@@ -92,6 +92,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(default: tools/lint/protocol.json)")
     ap.add_argument("--no-protocol", action="store_true",
                     help="skip the protocol state-machine pass (4a)")
+    ap.add_argument("--budgets", default=hotpath.DEFAULT_BUDGETS,
+                    help="checked hot-path cost budget artifact "
+                         "(default: tools/lint/budgets.json)")
+    ap.add_argument("--no-hotpath", action="store_true",
+                    help="skip the hot-path round-trip budget pass (4d)")
+    ap.add_argument("--hotpath-only", action="store_true",
+                    help="run only the hot-path budget pass (4d) — the "
+                         "make lint-hotpath edit loop")
+    ap.add_argument("--costs", action="store_true",
+                    help="print the derived per-op round-trip cost table "
+                         "and exit")
     ap.add_argument("--native-only", action="store_true",
                     help="run only the native passes: memory-order "
                          "discipline (4b) + error-path fd leaks (4c)")
@@ -110,11 +121,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("protocol    store op state machine vs protocol.json (4a)")
         print("memorder    atomics memory-order discipline in csrc (4b)")
         print("fd-leak     error-path close/unlink coverage in csrc (4c)")
+        print("hotpath     per-op round-trip costs vs budgets.json (4d)")
         return 0
 
     root = os.path.abspath(args.root)
     explicit_paths = bool(args.paths)
     allow = load_allowlist(args.allowlist)
+
+    def hotpath_walk() -> List[SourceFile]:
+        out: List[SourceFile] = []
+        for rel in hotpath.WALK_FILES:
+            p = os.path.join(root, rel.replace("/", os.sep))
+            sf = load_source(p, root) if os.path.exists(p) else None
+            if sf is not None:
+                out.append(sf)
+        return out
+
+    if args.costs:
+        proto = protocol.load_protocol(args.protocol)
+        print(hotpath.cost_table(args.budgets, hotpath_walk(), proto))
+        return 0
+
+    if args.hotpath_only:
+        proto = protocol.load_protocol(args.protocol)
+        findings = hotpath.check(args.budgets, hotpath_walk(), proto)
+        kept = [f for f in findings if not allow.allows(f)]
+        kept.sort(key=lambda f: (f.path, f.line, f.rule))
+        if args.json:
+            print(json.dumps([f.__dict__ for f in kept], indent=2))
+        else:
+            for f in kept:
+                print(f.render())
+            print(f"graftlint (hotpath): {len(kept)} finding(s) "
+                  f"({len(findings) - len(kept)} allowlisted)",
+                  file=sys.stderr)
+        return 1 if kept else 0
 
     def native_cc_files():
         csrc = os.path.join(root, "csrc")
@@ -269,6 +310,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "<protocol>", 1, protocol.RULE_DRIFT, "error",
                 f"protocol pass sources missing: {cc_path} / "
                 f"{', '.join(protocol.WALK_FILES)}"))
+
+    # Pass 4d: hot-path round-trip costs vs the committed budget
+    # artifact (tools/lint/budgets.json). Same walk discipline as 4a:
+    # canonical files only, receiver inference tuned for them.
+    if not args.no_wire and not args.no_hotpath:
+        walk = hotpath_walk()
+        if walk:
+            proto = protocol.load_protocol(args.protocol)
+            findings += hotpath.check(args.budgets, walk, proto)
+        elif not explicit_paths:
+            findings.append(Finding(
+                "<hotpath>", 1, hotpath.RULE_DRIFT, "error",
+                f"hotpath pass sources missing: "
+                f"{', '.join(hotpath.WALK_FILES)}"))
 
     # Passes 4b/4c: memory-order + error-path fd discipline over the
     # native planes (skipped when linting explicit fixture paths).

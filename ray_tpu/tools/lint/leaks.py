@@ -77,11 +77,23 @@ def _iter_functions(tree: ast.AST):
     yield from walk(tree, [], None)
 
 
+def _walk_own(fn: ast.AST):
+    """Walk fn's subtree without descending into nested defs — those are
+    yielded by _iter_functions and scanned on their own visit."""
+    todo = list(ast.iter_child_nodes(fn))
+    while todo:
+        node = todo.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            todo.extend(ast.iter_child_nodes(node))
+
+
 def _scan(sf: SourceFile, qual: str, cls: Optional[str], fn: ast.AST,
           table: Dict[str, Set[str]], all_methods: Set[str]
           ) -> List[Finding]:
     out: List[Finding] = []
-    for stmt in ast.walk(fn):
+    for stmt in _walk_own(fn):
         if not isinstance(stmt, ast.Expr):
             continue
         call = stmt.value

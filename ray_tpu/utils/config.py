@@ -82,6 +82,7 @@ _flag("graftcopy_threads", int, 0, "Copy-engine worker threads for scatter write
 _flag("graftcopy_min_bytes", int, 16 * 1024**2, "Route puts at least this large through the native scatter engine; smaller payloads use one os.pwritev (a pool handoff costs more than it saves).")
 _flag("put_executor_offload_bytes", int, 4 * 1024**2, "Loop-path puts larger than this copy on the default executor instead of the event loop; the same knob caps the legacy (graftcopy-off) synchronous fast-put path.")
 _flag("graftcopy_scratch_max_bytes", int, 2 * 1024**3, "Per-worker staging-inode recycling cap: the put plane keeps one private hardlink ('scratch-<pid>') to its last staging file so a delete drops only the store's name and the next put of at most this size rewrites the same hot tmpfs pages (cold page allocation halves write bandwidth); 0 disables recycling.")
+_flag("graftcopy_deferred_ack", bool, True, "Deferred-ack small puts: sub-graftshm_min_bytes graftcopy puts send their OP_PUT and return without reading the reply (the sidecar processes in order, so the object is visible to every later op); the ack rides the next client op and a failed adoption is repaired through the spill-capable agent path. Off = every put blocks on its reply.")
 
 # --- shared-memory object plane (graftshm) ---
 _flag("graftshm", bool, True, "Store-owned shared-memory put plane: OP_CREATE hands the worker a slab fd over SCM_RIGHTS, SerializedValue serializes in place through the mapping, OP_SEAL publishes — no staging file, no bulk copy phase. Falls back to the graftcopy path when off, the native library is unavailable, fd-passing fails, or the allocation cannot fit (ENOSPC).")

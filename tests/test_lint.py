@@ -16,9 +16,9 @@ from types import SimpleNamespace
 
 import pytest
 
-from ray_tpu.tools.lint import (event_loop, leaks, locks, memorder,
-                                protocol, resource_paths, rpc_signatures,
-                                wire_schema)
+from ray_tpu.tools.lint import (event_loop, hotpath, leaks, locks,
+                                memorder, protocol, resource_paths,
+                                rpc_signatures, wire_schema)
 from ray_tpu.tools.lint.__main__ import main as lint_main
 from ray_tpu.tools.lint.common import (load_allowlist, load_source,
                                        split_c_functions)
@@ -1639,3 +1639,267 @@ def test_cli_protocol_drift_fails_build(tmp_path, capsys):
     out = capsys.readouterr()
     assert rc == 1
     assert "protocol-drift" in out.out or "reply-path" in out.out
+
+
+# ---------------------------------------------------------------------------
+# pass 4d — hot-path round-trip costs vs tools/lint/budgets.json
+# ---------------------------------------------------------------------------
+
+def _hotpath_files():
+    return [load_source(os.path.join(REPO, p.replace("/", os.sep)), REPO)
+            for p in hotpath.WALK_FILES]
+
+
+def _real_proto():
+    return protocol.load_protocol(protocol.DEFAULT_PROTOCOL)
+
+
+def _mutated_budgets(tmp_path, mutate):
+    import json
+    with open(hotpath.DEFAULT_BUDGETS) as f:
+        budgets = json.load(f)
+    mutate(budgets)
+    p = tmp_path / "budgets.json"
+    p.write_text(json.dumps(budgets))
+    return str(p)
+
+
+def test_hotpath_identity_real_tree_matches_artifact():
+    # The committed artifact must re-derive EXACTLY from the real tree:
+    # this is the identity the CI gate enforces. If this fails after an
+    # intentional hot-path change, re-derive budgets.json (and justify
+    # any cost increase) — do not loosen the test.
+    fs = hotpath.check(hotpath.DEFAULT_BUDGETS, _hotpath_files(),
+                       _real_proto())
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_hotpath_budget_flip_artifact_cheaper_fails(tmp_path):
+    # Direction 1: artifact claims the tree is CHEAPER than it is
+    # (derived lowered below reality) -> the tree looks like a
+    # regression against the committed contract -> hotpath-drift.
+    art = _mutated_budgets(
+        tmp_path,
+        lambda b: b["ops"]["put"]["derived"].update({"sidecar_rt": 1}))
+    fs = hotpath.check(art, _hotpath_files(), _real_proto())
+    assert any(f.rule == "hotpath-drift" and "'put'" in f.message
+               for f in fs), [f.render() for f in fs]
+
+
+def test_hotpath_budget_flip_artifact_dearer_fails(tmp_path):
+    # Direction 2: artifact claims the tree is DEARER than it is
+    # (derived raised above reality) -> the tree got cheaper and the
+    # artifact must be tightened -> hotpath-drift again. Exact
+    # identity, not an inequality, in both directions.
+    art = _mutated_budgets(
+        tmp_path,
+        lambda b: b["ops"]["put"]["derived"].update({"sidecar_rt": 3}))
+    fs = hotpath.check(art, _hotpath_files(), _real_proto())
+    assert any(f.rule == "hotpath-drift" and "'put'" in f.message
+               for f in fs), [f.render() for f in fs]
+
+
+def test_hotpath_budget_ceiling_breach_fails(tmp_path):
+    # A budget cap below the (correctly re-derived) tree cost is a
+    # breach: derived matches, so no drift — the budget gate alone
+    # must catch it.
+    art = _mutated_budgets(
+        tmp_path,
+        lambda b: b["ops"]["put"]["budget"].update({"sidecar_rt": 1}))
+    fs = hotpath.check(art, _hotpath_files(), _real_proto())
+    assert any(f.rule == "hotpath-budget" and "'put'" in f.message
+               for f in fs), [f.render() for f in fs]
+    assert not any(f.rule == "hotpath-drift" for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_hotpath_stale_root_and_cold_entries_fail(tmp_path):
+    # Renamed/deleted functions must not rot silently in the artifact.
+    art = _mutated_budgets(
+        tmp_path,
+        lambda b: (b["ops"]["put"].update({"root": "CoreWorker._gone"}),
+                   b["cold"].update({"CoreWorker._also_gone": "stale"})))
+    fs = hotpath.check(art, _hotpath_files(), _real_proto())
+    msgs = " | ".join(f.message for f in fs)
+    assert "stale artifact" in msgs
+    assert "_gone" in msgs and "_also_gone" in msgs
+
+
+def test_hotpath_rpc_in_loop_flagged(tmp_path):
+    # The anti-pattern every sub-1.0x bench row shared: one awaited
+    # RPC per item. Cost counts the loop body ONCE (budgets are
+    # per-op, not per-item) but the finding fires at the call site.
+    sf = _sf(tmp_path, """
+        class W:
+            async def submit(self, items):
+                for it in items:
+                    await self.agent.call("push", it)
+    """)
+    budgets = {"ops": {"submit": {"root": "W.submit",
+                                  "derived": {"agent_rt": 1}}},
+               "cold": {}}
+    derived, findings = hotpath.derive_costs(budgets, [sf], _real_proto())
+    assert derived["submit"]["agent_rt"] == 1
+    assert _rules(findings) == ["rpc-in-loop"]
+    assert findings[0].qualname == "W.submit"
+
+
+def test_hotpath_rt_under_lock_flagged(tmp_path):
+    sf = _sf(tmp_path, """
+        class W:
+            async def submit(self, item):
+                async with self._lock:
+                    await self.controller.call("put", item)
+    """)
+    budgets = {"ops": {"submit": {"root": "W.submit",
+                                  "derived": {"controller_rt": 1}}},
+               "cold": {}}
+    derived, findings = hotpath.derive_costs(budgets, [sf], _real_proto())
+    assert derived["submit"]["controller_rt"] == 1
+    assert _rules(findings) == ["rt-under-lock"]
+
+
+def test_hotpath_helper_summary_poisons_loop_context(tmp_path):
+    # Interprocedural: the RPC lives in a helper with no loop of its
+    # own — the LOOP at the call site applies to everything the helper
+    # reaches. The finding lands on the caller's call site, attributed
+    # to the caller, naming the helper.
+    sf = _sf(tmp_path, """
+        class W:
+            async def _push_one(self, it):
+                await self.agent.call("push", it)
+
+            async def submit(self, items):
+                for it in items:
+                    await self._push_one(it)
+    """)
+    budgets = {"ops": {"submit": {"root": "W.submit",
+                                  "derived": {"agent_rt": 1}}},
+               "cold": {}}
+    derived, findings = hotpath.derive_costs(budgets, [sf], _real_proto())
+    assert derived["submit"]["agent_rt"] == 1
+    assert _rules(findings) == ["rpc-in-loop"]
+    assert findings[0].qualname == "W.submit"
+    assert "_push_one" in findings[0].message
+
+
+def test_hotpath_blocking_sidecar_rt_on_loop_flagged(tmp_path):
+    # A replying (reply:true) sidecar call in a sync helper reached
+    # from an async def blocks the whole event loop on the reply read.
+    sf = _sf(tmp_path, """
+        class W:
+            def _fetch(self, oid):
+                return self.store.get(oid)
+
+            async def submit(self, oid):
+                return self._fetch(oid)
+    """)
+    budgets = {"ops": {"get": {"root": "W.submit",
+                               "derived": {"sidecar_rt": 1}}},
+               "cold": {}}
+    derived, findings = hotpath.derive_costs(budgets, [sf], _real_proto())
+    assert derived["get"]["sidecar_rt"] == 1
+    assert "blocking-rt-on-loop" in _rules(findings)
+
+
+def test_hotpath_deferred_put_is_send_not_rt(tmp_path):
+    # put_deferred shares OP_PUT's replying wire slot but reads the
+    # ack on a later request: classified sidecar_send, and exempt from
+    # blocking-rt-on-loop (a socket write is microseconds).
+    sf = _sf(tmp_path, """
+        class W:
+            async def submit(self, oid, data):
+                self.store.put_deferred(oid, data)
+    """)
+    budgets = {"ops": {"put": {"root": "W.submit",
+                               "derived": {"sidecar_send": 1}}},
+               "cold": {}}
+    derived, findings = hotpath.derive_costs(budgets, [sf], _real_proto())
+    assert derived["put"]["sidecar_send"] == 1
+    assert derived["put"]["sidecar_rt"] == 0
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_hotpath_cold_functions_cost_zero(tmp_path):
+    # Miss/retry paths are correctness paths: a cold entry excludes a
+    # helper's round-trips from the caller's derived cost.
+    src = """
+        class W:
+            async def _fetch_remote(self, oid):
+                await self.agent.call("pull", oid)
+
+            async def submit(self, oid):
+                await self._fetch_remote(oid)
+    """
+    budgets = {"ops": {"get": {"root": "W.submit",
+                               "derived": {"agent_rt": 1}}},
+               "cold": {}}
+    derived, _ = hotpath.derive_costs(
+        budgets, [_sf(tmp_path, src)], _real_proto())
+    assert derived["get"]["agent_rt"] == 1
+    cold = {"ops": {"get": {"root": "W.submit", "derived": {}}},
+            "cold": {"W._fetch_remote": "miss path, not hot path"}}
+    derived, findings = hotpath.derive_costs(
+        cold, [_sf(tmp_path, src, "m2.py")], _real_proto())
+    assert derived["get"]["agent_rt"] == 0
+    assert findings == []
+
+
+def test_hotpath_allowlist_expiry_month_enforced(tmp_path):
+    # Suppressions cannot rot: an entry whose month is strictly before
+    # today's fails the whole lint run until re-justified or removed.
+    p = tmp_path / "allow.txt"
+    p.write_text("budgets.json : hotpath-drift : CoreWorker._put_direct"
+                 " : 2026-07 : re-batching in flight\n")
+    with pytest.raises(SystemExit, match="expired"):
+        load_allowlist(str(p), today="2026-08")
+    # Same month is still valid; future months too.
+    al = load_allowlist(str(p), today="2026-07")
+    assert len(al.entries) == 1
+    al = load_allowlist(str(p), today="2026-01")
+    assert len(al.entries) == 1
+
+
+def test_hotpath_allowlist_suppresses_matching_finding(tmp_path):
+    # The allowlist flow end-to-end: a drift finding with a matching
+    # (path, rule, qualname) entry is suppressed; others are not.
+    art = _mutated_budgets(
+        tmp_path,
+        lambda b: b["ops"]["put"]["derived"].update({"sidecar_rt": 1}))
+    fs = hotpath.check(art, _hotpath_files(), _real_proto())
+    drift = [f for f in fs if f.rule == "hotpath-drift"]
+    assert drift
+    f = drift[0]
+    p = tmp_path / "allow.txt"
+    p.write_text(f"{f.path} : {f.rule} : {f.qualname} : 2099-12 : "
+                 f"known while re-batching lands\n")
+    al = load_allowlist(str(p), today="2026-08")
+    assert al.allows(f)
+    assert al.unused() == []
+
+
+def test_cli_hotpath_only_clean(capsys):
+    rc = lint_main(["--hotpath-only"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+    assert "hotpath" in out.err
+
+
+def test_cli_hotpath_budget_flip_fails_build(tmp_path, capsys):
+    # CI acceptance: flipping a budgets.json entry fails the same
+    # invocation ci.sh runs first.
+    art = _mutated_budgets(
+        tmp_path,
+        lambda b: b["ops"]["put"]["derived"].update({"sidecar_rt": 1}))
+    rc = lint_main(["--hotpath-only", "--budgets", art])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "hotpath-drift" in out.out
+
+
+def test_cli_costs_table(capsys):
+    rc = lint_main(["--costs"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "sidecar_rt" in out.out and "put" in out.out
+    assert "derived[/budget]" in out.out
