@@ -239,14 +239,23 @@ def get_actor(name: str) -> ActorHandle:
 # ---------------------------------------------------------------------------
 
 class PlacementGroup:
-    def __init__(self, pg_id: PlacementGroupID, bundles: List[dict]):
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[dict],
+                 state: Optional[str] = None):
         self.id = pg_id
         self.bundles = bundles
+        # graftsched one-op create replies carry the terminal state, so
+        # ready() resolves locally with zero RPCs. Deserialized handles
+        # (and legacy creates) fall back to the wait_pg_ready long-poll.
+        self._state = state
 
     def ready(self, timeout: float = 60.0) -> bool:
+        if self._state == "CREATED":
+            return True
         cw = _cw()
         state = cw._run(cw.controller.call(
             "wait_pg_ready", self.id.binary(), timeout)).result()
+        if state == "CREATED":
+            self._state = state
         return state == "CREATED"
 
     def __reduce__(self):
@@ -276,16 +285,18 @@ def placement_group(bundles: List[Dict[str, float]],
                          "per bundle")
     cw = _cw()
     pg_id = PlacementGroupID.random()
-    cw._run(cw.controller.call(
+    reply = cw._run(cw.controller.call(
         "create_placement_group", pg_id.binary(), bundles,
         strategy, bundle_label_selector)).result()
-    return PlacementGroup(pg_id, bundles)
+    state = reply.get("state") if isinstance(reply, dict) else None
+    return PlacementGroup(pg_id, bundles, state)
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
     cw = _cw()
     cw._run(cw.controller.call(
         "remove_placement_group", pg.id.binary())).result()
+    pg._state = None  # ready() consults the controller again
 
 
 # ---------------------------------------------------------------------------

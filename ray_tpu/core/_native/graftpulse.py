@@ -291,13 +291,29 @@ class PulseAssembler:
                  prof_oncpu_permille: int = 0,
                  prof_gil_permille: int = 0,
                  extra_sources: Optional[Dict[str, Tuple[dict, dict]]]
-                 = None) -> Pulse:
+                 = None,
+                 banked_deltas: Optional[Dict[str, tuple]] = None) -> Pulse:
         kinds: Dict[str, Tuple[int, int, int, Tuple[int, ...]]] = {}
         self._fold_source(kinds, "self",
                           graftscope.counters(), graftscope.histograms())
         extra = extra_sources or {}
         for source, (cur_c, cur_h) in extra.items():
             self._fold_source(kinds, source, cur_c, cur_h)
+        # Pre-aggregated sparse deltas (workers diff their own cumulative
+        # blocks and ship only non-zero rows): a straight merge — no
+        # per-source normalization, restart detection or `_last`
+        # bookkeeping, which is what made the per-tick fold contend with
+        # dispatch on small hosts.
+        for name, d in (banked_deltas or {}).items():
+            acc = kinds.get(name)
+            if acc is None:
+                kinds[name] = (int(d[0]), int(d[1]), int(d[2]),
+                               tuple(int(x) for x in d[3]))
+            else:
+                kinds[name] = (acc[0] + int(d[0]), acc[1] + int(d[1]),
+                               acc[2] + int(d[2]),
+                               merge_hists(acc[3],
+                                           tuple(int(x) for x in d[3])))
         # Forget sources that vanished (dead workers) so their stale
         # cumulative blocks can't mask a same-key successor's counters.
         live = {"self"} | set(extra)

@@ -557,8 +557,14 @@ class TrailLedger:
                     f"sealed on node {rec.node} which is not alive and "
                     f"never freed — free event lost")
                 leaked.append(row)
-            elif residents is not None and rec.node in residents \
+            elif residents is not None and rec.plane != "inline" \
+                    and rec.node in residents \
                     and rec.oid not in residents[rec.node]:
+                # Inline-plane objects live in their OWNER's heap and
+                # ride reply frames — the store never holds them, so the
+                # agents' resident sets are not ground truth for them
+                # (only the node-death fold and owner-attested frees
+                # settle inline records).
                 row["audit_reason"] = (
                     f"ledger says live on node {rec.node} but the node "
                     f"no longer holds it — free event lost")

@@ -48,6 +48,7 @@ class NodeEntry:
         self.state = NodeState.ALIVE
         self.last_heartbeat = time.monotonic()
         self.client = RpcClient(addr)
+        self.num_leases = 0  # last graftsched delta-synced lease count
 
 
 class ActorEntry:
@@ -818,6 +819,21 @@ class Controller:
         node.resources_available = resources_available
         return True
 
+    async def report_sched_delta(self, node_id: bytes,
+                                 resources_available: dict,
+                                 num_leases: int) -> None:
+        """graftsched scheduling-delta sync: agents push a coalesced,
+        fire-and-forget view of their local resource ledger whenever
+        they grant/reclaim leases locally (ray_syncer's shape: deltas
+        flow one way, the periodic heartbeat remains the anti-entropy
+        backstop). Keeps controller-side spillback picks honest between
+        heartbeats without any awaited round-trip on the grant path."""
+        node = self.nodes.get(node_id)
+        if node is None or node.state != NodeState.ALIVE:
+            return
+        node.resources_available = resources_available
+        node.num_leases = num_leases
+
     async def get_nodes(self) -> list:
         return [{
             "node_id": n.node_id, "addr": n.addr, "state": n.state,
@@ -1215,8 +1231,64 @@ class Controller:
         pg = PGEntry(pg_id, bundles, strategy, bundle_label_selector)
         self.pgs[pg_id] = pg
         self._mark_dirty()
+        if GlobalConfig.graftsched and await self._create_pg_oneop(pg):
+            # graftsched fast path landed: the reply carries the state
+            # so the caller's ready() resolves locally, no extra RPC.
+            return {"pg_id": pg_id, "state": pg.state}
         spawn(self._schedule_pg(pg))
-        return {"pg_id": pg_id}
+        return {"pg_id": pg_id, "state": pg.state}
+
+    async def _create_pg_oneop(self, pg: PGEntry) -> bool:
+        """graftsched one-op PG create: plan synchronously from the
+        controller's (delta-synced) resource view, then fold prepare +
+        commit into ONE batched agent round per node — the agent applies
+        its node's bundles all-or-nothing and rolls back locally, so the
+        cross-node 2-phase dance collapses to a single gather. Any
+        wrinkle (infeasible plan, a node refusing, RPC failure) rolls
+        back whatever committed and returns False so the retrying
+        two-phase scheduler takes over unchanged."""
+        plan = self._plan_pg(pg)
+        if plan is None:
+            return False
+        per_node: Dict[bytes, list] = {}
+        order: List[NodeEntry] = []
+        for i, node in enumerate(plan):
+            if node.node_id not in per_node:
+                per_node[node.node_id] = []
+                order.append(node)
+            per_node[node.node_id].append((i, pg.bundles[i]))
+
+        async def _one(node: NodeEntry) -> bool:
+            try:
+                return bool(await node.client.call(
+                    "prepare_commit_bundles", pg.pg_id,
+                    per_node[node.node_id]))
+            except Exception:
+                return False
+
+        results = await asyncio.gather(*[_one(n) for n in order])
+        removed = self.pgs.get(pg.pg_id) is not pg  # raced a remove
+        if all(results) and not removed:
+            for node in order:
+                for i, _ in per_node[node.node_id]:
+                    pg.bundle_nodes[i] = node.node_id
+            pg.state = PGState.CREATED
+            pg.event.set()
+            self._mark_dirty()
+            return True
+        for node, ok in zip(order, results):  # rollback committed nodes
+            if ok:
+                try:
+                    await node.client.call(
+                        "return_bundles", pg.pg_id,
+                        [i for i, _ in per_node[node.node_id]])
+                except Exception:
+                    pass
+        if removed:
+            pg.state = PGState.REMOVED
+            pg.event.set()
+            return True  # don't hand a removed PG to the scheduler
+        return False
 
     def _plan_pg(self, pg: PGEntry) -> Optional[List[NodeEntry]]:
         """Choose a node per bundle respecting the strategy and per-bundle
@@ -1346,13 +1418,28 @@ class Controller:
         if pg is None:
             return
         self._mark_dirty()
-        for i, node_id in enumerate(pg.bundle_nodes):
-            node = self.nodes.get(node_id) if node_id else None
-            if node and node.state == NodeState.ALIVE:
-                try:
-                    await node.client.call("return_bundle", pg_id, i)
-                except Exception:
-                    pass
+        if GlobalConfig.graftsched:
+            # One batched return per node instead of one RPC per bundle.
+            per_node: Dict[bytes, list] = {}
+            for i, node_id in enumerate(pg.bundle_nodes):
+                if node_id:
+                    per_node.setdefault(node_id, []).append(i)
+            for node_id, indices in per_node.items():
+                node = self.nodes.get(node_id)
+                if node and node.state == NodeState.ALIVE:
+                    try:
+                        await node.client.call("return_bundles", pg_id,
+                                               indices)
+                    except Exception:
+                        pass
+        else:
+            for i, node_id in enumerate(pg.bundle_nodes):
+                node = self.nodes.get(node_id) if node_id else None
+                if node and node.state == NodeState.ALIVE:
+                    try:
+                        await node.client.call("return_bundle", pg_id, i)
+                    except Exception:
+                        pass
         pg.state = PGState.REMOVED
 
     async def get_pg_info(self, pg_id: bytes) -> Optional[dict]:

@@ -301,6 +301,26 @@ class CoreWorker:
         # flushed to the controller on the task-event flusher tick.
         self._scope = None
         self._scope_spans: list = []
+        # graftpulse pre-aggregation: the cumulative scope block as of
+        # the last report_scope_delta flush (counters, hists).
+        self._scope_sent: tuple = ({}, {})
+        # task-phase breakdown (ns accumulators + task count), read by
+        # bench_core.py so a dispatch regression localizes to submit /
+        # lease / run / reply.
+        self._task_phase = {"submit": 0, "lease": 0, "run": 0,
+                            "reply": 0, "tasks": 0}
+        # graftsched inline provenance: owner-attested trail events for
+        # inline objects at/under graftsched_inline_bytes. A sealed
+        # event DEBOUNCES one full flush window before shipping: an
+        # object freed while still pending cancels locally and the
+        # trail never hears of it (hot-loop results/puts are invisible
+        # by design, like the store's scratch inodes), while anything
+        # that survives a window is attested and its eventual free
+        # ships as the matching inline-plane event.
+        self._inline_pending: Dict[str, tuple] = {}  # hx -> sealed event
+        self._inline_shipped: set = set()  # oids with sealed shipped
+        self._inline_freed_buf: list = []
+        self._inline_cap = None  # cached graftsched_inline_bytes
         # Actor-dispatch wakeup coalescing: user threads append specs to
         # _actor_push_buf directly (GIL-atomic) and poke the drainer once
         # per burst — no per-call coroutine/Task/Future on the hot path.
@@ -540,6 +560,16 @@ class CoreWorker:
             on = CoreWorker._trail_enabled = grafttrail.enabled()
         return on
 
+    # graftsched fast path (batched lease waves + lease keep-alive +
+    # inline-result provenance); cached per-process like the trail flag.
+    _sched_enabled = None
+
+    def _sched_on(self) -> bool:
+        on = CoreWorker._sched_enabled
+        if on is None:
+            on = CoreWorker._sched_enabled = bool(GlobalConfig.graftsched)
+        return on
+
     def _record_task_event(self, task_id: bytes, name: str,
                            state: str, trace_id: bytes = b"",
                            parent_span: bytes = b"", *, attempt: int = 0,
@@ -574,7 +604,24 @@ class CoreWorker:
     def _flush_task_events(self) -> None:
         with self._task_events_lock:
             batch, self._task_events = self._task_events, []
-        if not batch:
+            objs = None
+            if self._inline_pending or self._inline_freed_buf:
+                # Attest only sealed events at least one full flush
+                # quantum old (freed while pending cancelled silently),
+                # plus the freed events of previously-attested objects.
+                # Aging is by wall time, not flush count: batch-cap
+                # flushes mid-burst must not prematurely age a burst's
+                # own short-lived results.
+                cutoff = time.time() - 2.0
+                ship = [hx for hx, ev in self._inline_pending.items()
+                        if ev[2] <= cutoff]
+                objs = [self._inline_pending.pop(hx) for hx in ship]
+                for hx in ship:
+                    self._inline_shipped.add(bytes.fromhex(hx))
+                objs.extend(self._inline_freed_buf)
+                self._inline_freed_buf = []
+                objs = objs or None
+        if not batch and not objs:
             return
         from ray_tpu.core._native import grafttrail
         owner = self.worker_id.hex()[:8]
@@ -617,7 +664,7 @@ class CoreWorker:
                 pspan=pspan, parent=pspan,
                 actor=actor.hex()[:12] if actor else "",
                 node=node, worker=wkr, err=err))
-        self._spawn(self._send_trail_events(events))
+        self._spawn(self._send_trail_events(events, objs))
 
     async def _send_task_events(self, batch: list) -> None:
         try:
@@ -625,19 +672,23 @@ class CoreWorker:
         except Exception:
             pass  # observability is best-effort
 
-    async def _send_trail_events(self, events: list) -> None:
+    async def _send_trail_events(self, events: list,
+                                 objects: Optional[list] = None) -> None:
         """Ship trail transitions one hop to the node agent, which folds
         every hosted worker's batch into its flush tick (graftpulse's
-        transport shape). A process with no agent registration yet falls
-        back to reporting straight to the controller."""
+        transport shape). `objects` carries owner-attested inline-plane
+        object events (graftsched) in the same frame. A process with no
+        agent registration yet falls back to reporting straight to the
+        controller."""
         try:
             agent = getattr(self, "agent", None)
             if agent is not None:
                 await agent.call("report_trail",
-                                 self.worker_id.binary(), events)
+                                 self.worker_id.binary(), events,
+                                 objects or None)
             else:
                 await self.controller.call("report_trail_batch", b"",
-                                           events, [])
+                                           events, objects or [])
         except Exception:
             pass  # observability is best-effort
 
@@ -711,14 +762,20 @@ class CoreWorker:
             buf, self._scope_spans = self._scope_spans, []
             spans.extend(buf)
         # Worker-process counters (rpc send/flush, copy) fold into this
-        # process's metrics registry on the same tick, and the
-        # cumulative blocks ride to the node agent so the graftpulse
-        # tick can fold client-side op deltas into the node pulse.
+        # process's metrics registry on the same tick. The node pulse
+        # needs the client-side op deltas too, but the agent's tick must
+        # not pay a per-source cumulative-block fold while it is also
+        # dispatching — so THIS process diffs its own cumulative blocks
+        # against what it last shipped and forwards only the sparse
+        # non-zero delta rows (report_scope_delta); the agent's fold
+        # degenerates to one dict merge.
         graftscope.publish_counters()
         counters = graftscope.counters()
         if counters and getattr(self, "agent", None) is not None:
-            self._spawn(self._send_scope_blocks(
-                counters, graftscope.histograms()))
+            deltas = self._diff_scope_blocks(counters,
+                                             graftscope.histograms())
+            if deltas:
+                self._spawn(self._send_scope_delta(deltas))
         if spans:
             # Bound the batch: a controller outage must not turn the
             # span buffer into a leak.
@@ -730,11 +787,34 @@ class CoreWorker:
         except Exception:
             pass  # observability is best-effort
 
-    async def _send_scope_blocks(self, counters: dict,
-                                 hists: dict) -> None:
+    def _diff_scope_blocks(self, counters: dict, hists: dict) -> dict:
+        """Sparse per-kind delta of this process's cumulative scope
+        blocks since the last flush: {kind: (dcalls, dbytes, dns,
+        dhist)} with all-zero rows dropped. The counters only ever grow
+        within one process, so a plain subtraction is exact — the
+        restart-detection the agent-side fold needed disappears with
+        the cumulative transport."""
+        prev_c, prev_h = self._scope_sent
+        deltas = {}
+        for name, cb in counters.items():
+            calls, nbytes, ns = (int(x) for x in cb)
+            ch = tuple(int(x) for x in hists.get(name, ()))
+            pc = prev_c.get(name, (0, 0, 0))
+            ph = prev_h.get(name, (0,) * len(ch))
+            dh = tuple(max(0, a - b) for a, b in zip(ch, ph))
+            dc = max(0, calls - pc[0])
+            db = max(0, nbytes - pc[1])
+            dn = max(0, ns - pc[2])
+            if dc or db or dn or any(dh):
+                deltas[name] = (dc, db, dn, dh)
+            prev_c[name] = (calls, nbytes, ns)
+            prev_h[name] = ch
+        return deltas
+
+    async def _send_scope_delta(self, deltas: dict) -> None:
         try:
-            await self.agent.call("report_scope",
-                                  self.worker_id.binary(), counters, hists)
+            await self.agent.call("report_scope_delta",
+                                  self.worker_id.binary(), deltas)
         except Exception:
             pass  # observability is best-effort
 
@@ -755,6 +835,52 @@ class CoreWorker:
         e.size = len(data)
         if e.event:
             e.event.set()
+        self._note_inline_sealed(oid, len(data))
+
+    def _note_inline_sealed(self, oid: bytes, size: int) -> None:
+        """graftsched inline provenance (owner-attested): a small inline
+        object never touches the store, so the OWNER is the only process
+        that can witness its lifecycle. Objects at/under
+        graftsched_inline_bytes get a sealed event on the dedicated
+        'inline' plane, debounced one flush window (see __init__ note);
+        the paired freed event ships from the pop sites in
+        _try_sync_drop / _drain_owned_drops / _maybe_free. Every
+        _mark_ready_inline call site runs owner-side (put_inline_marker,
+        _do_put, task-reply returns, streamed returns), so hooking here
+        covers them all. Larger inline objects stay untracked, as
+        before."""
+        cap = self._inline_cap
+        if cap is None:
+            cap = self._inline_cap = (
+                GlobalConfig.graftsched_inline_bytes
+                if (self._sched_on() and self._trail_on()) else 0)
+        if not cap or size > cap:
+            return
+        from ray_tpu.core._native import grafttrail
+        node = self.node_id.hex()[:12] if self.node_id else ""
+        hx = oid.hex()
+        with self._task_events_lock:
+            if hx in self._inline_pending or oid in self._inline_shipped:
+                return  # a task retry re-marked an attested return
+            self._inline_pending[hx] = grafttrail.object_event(
+                hx, "sealed", time.time(), size=size, plane="inline",
+                node=node, owner=self.worker_id.hex()[:8])
+
+    def _note_inline_freed(self, oid: bytes) -> None:
+        if not self._inline_pending and not self._inline_shipped:
+            return
+        from ray_tpu.core._native import grafttrail
+        node = self.node_id.hex()[:12] if self.node_id else ""
+        hx = oid.hex()
+        with self._task_events_lock:
+            if self._inline_pending.pop(hx, None) is not None:
+                return  # freed before attestation: cancel the pair
+            if oid not in self._inline_shipped:
+                return
+            self._inline_shipped.discard(oid)
+            self._inline_freed_buf.append(grafttrail.object_event(
+                hx, "freed", time.time(), plane="inline", node=node,
+                owner=self.worker_id.hex()[:8]))
 
     def _mark_ready_stored(self, oid: bytes, node_id: bytes, addr: Address,
                            size: int) -> None:
@@ -846,6 +972,7 @@ class CoreWorker:
                 return False  # odd state: let the loop path reason
             self.objects.pop(k, None)
             self._drop_map_cache(k)
+            self._note_inline_freed(k)
             return True
         if len(e.locations) != 1 or self.agent_addr is None:
             return False
@@ -857,6 +984,7 @@ class CoreWorker:
             return False
         self.objects.pop(k, None)
         self._drop_map_cache(k)
+        self._note_inline_freed(k)
         try:
             # Fire-and-forget: the sidecar erases without replying; the
             # outcome (rc 0 = name gone now) rides the next put/contains
@@ -893,6 +1021,7 @@ class CoreWorker:
             self.objects.pop(oid, None)
             self.free_device_object(oid)
             self._drop_map_cache(oid)
+            self._note_inline_freed(oid)
             if e.locations:
                 for node_id, addr in e.locations:
                     self._free_buf.setdefault(tuple(addr), []).append(oid)
@@ -958,6 +1087,7 @@ class CoreWorker:
         self.objects.pop(oid, None)
         self.free_device_object(oid)
         self._drop_map_cache(oid)
+        self._note_inline_freed(oid)
         for node_id, addr in list(e.locations):
             self._free_buf.setdefault(tuple(addr), []).append(oid)
         if e.locations and not self._free_flush_scheduled:
@@ -2637,6 +2767,7 @@ class CoreWorker:
             label_selector=label_selector,
         )
         spec.fn_async_export = async_export
+        spec._ph0 = time.perf_counter_ns()  # task_phase_us: submit stamp
         spec.trace_id, spec.parent_span = \
             self._trace_for_new_task(task_id.binary())
         self._task_arg_refs[task_id.binary()] = held
@@ -2728,6 +2859,7 @@ class CoreWorker:
         if q is None:
             q = self._class_queues[key] = []
         fut = asyncio.get_running_loop().create_future()
+        spec._ph1 = time.perf_counter_ns()  # task_phase_us: queued stamp
         q.append((spec, fut))
         self._class_event(key).set()
         self._ensure_pump(key)
@@ -2804,7 +2936,32 @@ class CoreWorker:
                             tuple(preferred) == tuple(self.agent_addr):
                         preferred = None
 
+                def _start_runner(r):
+                    runner = asyncio.ensure_future(
+                        self._lease_runner(key, r))
+                    runners.add(runner)
+                    runner.add_done_callback(
+                        lambda t, _r=runners, _e=ev: (_r.discard(t),
+                                                      _e.set()))
+
+                async def _probe_preferred():
+                    # Short queue-wait probe: a busy preferred node must
+                    # not stall the local fallback.
+                    try:
+                        r = await self._client_for_worker(
+                            tuple(preferred)).call(
+                            "request_lease", spec0.resources,
+                            None, -1, None, spec0.label_selector,
+                            _no_spill=True, queue_wait_ms=50)
+                    except Exception:
+                        return None
+                    if r and r.get("granted"):
+                        r["spilled_to"] = tuple(preferred)
+                        return r
+                    return None  # preferred busy: go local
+
                 async def _request_one():
+                    # Legacy per-lease path (RAY_TPU_GRAFTSCHED=0).
                     # Start the runner THE MOMENT a grant lands: siblings
                     # of this wave park server-side for the queue-wait
                     # budget, and a gather-then-start would leave granted
@@ -2812,20 +2969,7 @@ class CoreWorker:
                     # slowdown when a wave mixes grants and parks).
                     r = None
                     if preferred is not None:
-                        try:
-                            # Short queue-wait probe: a busy preferred
-                            # node must not stall the local fallback.
-                            r = await self._client_for_worker(
-                                tuple(preferred)).call(
-                                "request_lease", spec0.resources,
-                                None, -1, None, spec0.label_selector,
-                                _no_spill=True, queue_wait_ms=50)
-                        except Exception:
-                            r = None
-                        if r and r.get("granted"):
-                            r["spilled_to"] = tuple(preferred)
-                        else:
-                            r = None  # preferred busy: go local
+                        r = await _probe_preferred()
                     if r is None:
                         r = await self.agent.call(
                             "request_lease", spec0.resources,
@@ -2833,31 +2977,63 @@ class CoreWorker:
                             spec0.scheduling_strategy,
                             spec0.label_selector)
                     if r.get("granted"):
-                        runner = asyncio.ensure_future(
-                            self._lease_runner(key, r))
-                        runners.add(runner)
-                        runner.add_done_callback(
-                            lambda t, _r=runners, _e=ev: (_r.discard(t),
-                                                          _e.set()))
+                        _start_runner(r)
                     return r
 
-                results = await asyncio.gather(
-                    *[_request_one() for _ in range(want)],
-                    return_exceptions=True)
-                errors = [r for r in results if isinstance(r, BaseException)]
-                granted_n = sum(1 for r in results if isinstance(r, dict)
-                                and r.get("granted"))
-                denied_n = sum(1 for r in results if isinstance(r, dict)
-                               and not r.get("granted"))
+                errors: list = []
+                granted_n = denied_n = 0
+                want0 = want
+                if self._sched_on():
+                    # graftsched: the whole wave is ONE batched agent RPC
+                    # granted from the node's local resource view; the
+                    # agent falls back to server-side parking / controller
+                    # spillback itself when it can grant nothing.
+                    if preferred is not None:
+                        r = await _probe_preferred()
+                        if r is not None:
+                            _start_runner(r)
+                            granted_n += 1
+                            want -= 1
+                    if want > 0:
+                        try:
+                            # lint: allow(rpc-in-loop: one BATCHED lease wave per pump iteration — the batching IS this call; per-lease RPCs are the legacy path)
+                            rb = await self.agent.call(
+                                "request_lease_batch", want,
+                                spec0.resources, spec0.placement_group,
+                                spec0.pg_bundle_index,
+                                spec0.scheduling_strategy,
+                                spec0.label_selector)
+                            grants = rb.get("granted") or []
+                            for r in grants:
+                                _start_runner(r)
+                            granted_n += len(grants)
+                            denied_n = want - len(grants)
+                        except Exception as e:
+                            errors.append(e)
+                    results_n = max(1, len(errors) + (1 if granted_n
+                                                      or denied_n else 0))
+                else:
+                    results = await asyncio.gather(
+                        *[_request_one() for _ in range(want)],
+                        return_exceptions=True)
+                    errors = [r for r in results
+                              if isinstance(r, BaseException)]
+                    granted_n = sum(1 for r in results
+                                    if isinstance(r, dict)
+                                    and r.get("granted"))
+                    denied_n = sum(1 for r in results
+                                   if isinstance(r, dict)
+                                   and not r.get("granted"))
+                    results_n = len(results)
                 if denied_n:
                     self._class_lease_cap[key] = max(
                         1, len(runners))
-                elif granted_n == want and q:
+                elif granted_n == want0 and q:
                     # Gentle growth: +1 per fully-granted wave with
                     # backlog left (aggressive doubling overshoots into
                     # park-then-surplus-worker churn on small nodes).
                     self._class_lease_cap[key] = min(max_leases, cap + 1)
-                if errors and len(errors) == len(results):
+                if errors and len(errors) == results_n:
                     # Agent unreachable: don't hang callers forever — after
                     # a sustained streak, fail everything still queued so
                     # _submit_with_retries / the caller sees the error.
@@ -2888,18 +3064,23 @@ class CoreWorker:
         is multiplexed; execution on the worker stays serial in its exec
         pool). Pipelining hides per-task RPC latency — the reference gets
         its small-task throughput the same way (normal_task_submitter.cc
-        pipelines onto cached leases). Returns the lease when the backlog
-        drains or the worker looks broken."""
+        pipelines onto cached leases). Returns the lease when the worker
+        looks broken, or when the backlog drains AND stays drained for
+        the graftsched keep-alive TTL — steady-state task streams pay
+        one worker push per task and zero lease RPCs."""
         q = self._class_queues[key]
         worker_addr = tuple(lease["worker_addr"])
         lease_node = lease.get("spilled_to", self.agent_addr)
         node_hex = (lease.get("node_id") or b"").hex()[:12]
         client = self._client_for_worker(worker_addr)
         depth = max(1, GlobalConfig.worker_lease_pipeline_depth)
+        keepalive = (GlobalConfig.graftsched_keepalive_ms / 1000
+                     if self._sched_on() else 0.0)
+        ev = self._class_event(key)
         inflight: set = set()
         broken = False
         try:
-            while (q or inflight) and not broken:
+            while not broken:
                 while q and len(inflight) < depth:
                     # Coalesce a run of REF-FREE specs into one batched
                     # push (same RPC-amortization as the actor path; a
@@ -2951,7 +3132,25 @@ class CoreWorker:
                             self._push_task_batch_out(client, batch,
                                                       key)))
                 if not inflight:
-                    break
+                    if q:
+                        continue  # popped only done-futs: refill
+                    # graftsched keep-alive: the backlog drained — hold
+                    # the leased worker for the TTL instead of paying
+                    # the return+re-request lease round-trip pair on
+                    # the next burst. The pump counts parked runners,
+                    # so it never over-leases while we wait.
+                    if keepalive <= 0:
+                        break
+                    ev.clear()
+                    if q:
+                        continue  # a submit raced the clear: drain it
+                    try:
+                        await asyncio.wait_for(ev.wait(), keepalive)
+                    except asyncio.TimeoutError:
+                        pass
+                    if not q:
+                        break
+                    continue
                 done, inflight = await asyncio.wait(
                     inflight, return_when=asyncio.FIRST_COMPLETED)
                 broken = any(d.result() is False for d in done)
@@ -2969,6 +3168,27 @@ class CoreWorker:
         prev = self._class_task_ms.get(key, ms)
         self._class_task_ms[key] = 0.7 * prev + 0.3 * ms
 
+    def _note_task_phases(self, spec: TaskSpec, t_push: int,
+                          t_reply: int) -> None:
+        """Fold one settled task into the phase accumulators: submit
+        (API entry -> class-queue enqueue), lease (enqueue -> push),
+        run (push -> reply), reply (reply -> refs settled)."""
+        ph0 = getattr(spec, "_ph0", None)
+        if ph0 is None:
+            return
+        ph = self._task_phase
+        ph["submit"] += spec._ph1 - ph0
+        ph["lease"] += t_push - spec._ph1
+        ph["run"] += t_reply - t_push
+        ph["reply"] += time.perf_counter_ns() - t_reply
+        ph["tasks"] += 1
+
+    def task_phase_snapshot(self) -> Dict[str, int]:
+        """Copy of the task-phase breakdown counters (ns per phase +
+        total tasks); consumed by bench_core.py so a dispatch regression
+        localizes to submit vs lease vs run vs reply."""
+        return dict(self._task_phase)
+
     async def _push_one(self, client: RpcClient, spec: TaskSpec,
                         fut: asyncio.Future,
                         key: Optional[tuple] = None) -> bool:
@@ -2976,11 +3196,13 @@ class CoreWorker:
         the reply), False when the worker is suspect."""
         self._task_exec_addr[spec.task_id] = tuple(client._address)
         try:
-            t0 = time.monotonic()
+            t0 = time.perf_counter_ns()
             reply = await client.call("push_task",
                                       pickle.dumps(spec, protocol=5))
-            self._note_class_ms(key, (time.monotonic() - t0) * 1000)
+            tr = time.perf_counter_ns()
+            self._note_class_ms(key, (tr - t0) / 1e6)
             self._process_task_reply(spec, reply, client)
+            self._note_task_phases(spec, t0, tr)
             self._release_arg_refs(spec)
             if not fut.done():
                 fut.set_result(None)
@@ -3002,12 +3224,13 @@ class CoreWorker:
             self._task_exec_addr[spec.task_id] = tuple(client._address)
             blobs.append(pickle.dumps(spec, protocol=5))
         try:
-            t0 = time.monotonic()
+            t0 = time.perf_counter_ns()
             replies = await client.call("push_task_batch", blobs)
-            self._note_class_ms(
-                key, (time.monotonic() - t0) * 1000 / len(items))
+            tr = time.perf_counter_ns()
+            self._note_class_ms(key, (tr - t0) / 1e6 / len(items))
             for (spec, fut), reply in zip(items, replies):
                 self._process_task_reply(spec, reply, client)
+                self._note_task_phases(spec, t0, tr)
                 self._release_arg_refs(spec)
                 if not fut.done():
                     fut.set_result(None)

@@ -223,6 +223,15 @@ class NodeAgent:
         self._resource_cv = asyncio.Condition()
         self._lease_ticket_seq = 0
         self._lease_waiters: Dict[int, dict] = {}  # FIFO grant order
+        # graftsched: coalesced fire-and-forget resource-delta sync to
+        # the controller (ray_syncer's shape) — grants/returns between
+        # heartbeats mark dirty; one RPC per coalescing window.
+        self._sched_sync_scheduled = False
+        # graftpulse: worker-shipped sparse scope DELTAS banked between
+        # pulse ticks (the workers pre-aggregate; the tick only merges).
+        self._pulse_banked: Dict[str, tuple] = {}
+        self._pulse_rss = (0, 0)  # (tick stamp, cached summed worker RSS)
+        self._pulse_tick = 0
         # grafttrail: node-level batch of task/object transitions. Hosted
         # workers hand their task batches over one local hop
         # (report_trail); the agent adds object provenance from the store
@@ -528,8 +537,14 @@ class NodeAgent:
                     if wid in self.workers}
                 extra = {"w:" + wid.hex()[:12]: blocks
                          for wid, blocks in self._worker_scope.items()}
-                pids = [w.proc.pid for w in self.workers.values()
-                        if w.proc.poll() is None]
+                banked, self._pulse_banked = self._pulse_banked, {}
+                self._pulse_tick += 1
+                # The per-worker /proc RSS walk is the tick's only file
+                # i/o; RSS moves on seconds timescales, so refresh it on
+                # every 5th tick and reuse the cached sum in between.
+                scan_rss = (self._pulse_tick % 5) == 1
+                pids = ([w.proc.pid for w in self.workers.values()
+                         if w.proc.poll() is None] if scan_rss else [])
                 fp = self._fastpath
                 oncpu_pm, gil_pm = self._prof_permille()
                 store_used = self.store.used()
@@ -543,9 +558,15 @@ class NodeAgent:
                     free_b = free_slabs = 0
                     if fp is not None:
                         free_b, free_slabs, _ = fp.shm_stats()
-                    rss = sum(graftpulse.proc_rss_bytes(p) for p in pids)
+                    if scan_rss:
+                        rss = sum(graftpulse.proc_rss_bytes(p)
+                                  for p in pids)
+                        self._pulse_rss = (self._pulse_tick, rss)
+                    else:
+                        rss = self._pulse_rss[1]
                     return graftpulse.encode(asm.assemble(
                         extra_sources=extra,
+                        banked_deltas=banked,
                         store_used=store_used,
                         store_capacity=store_capacity,
                         store_objects=store_objects,
@@ -686,6 +707,7 @@ class NodeAgent:
             if lease:
                 _, res, pg, bundle_index = lease
                 await self._return_resources(res, pg, bundle_index)
+                self._mark_sched_dirty()
             w.current_lease = None
         if w.dedicated_actor is not None:
             actor_id = w.dedicated_actor
@@ -1065,14 +1087,36 @@ class NodeAgent:
 
     async def report_scope(self, worker_id: bytes, counters: dict,
                            hists: dict) -> None:
-        """graftpulse: a worker's cumulative scope counter/histogram
-        blocks, forwarded on its flush tick. The pulse loop folds these
-        into the node pulse — the hot client-side kinds (rpc_send/flush,
-        copy scatter, shm in-place writes) never tick in the agent
-        process, so without them the pulse would carry sidecar service
-        ops and nothing else."""
+        """graftpulse (legacy transport): a worker's cumulative scope
+        counter/histogram blocks, forwarded on its flush tick. The pulse
+        loop folds these into the node pulse — the hot client-side kinds
+        (rpc_send/flush, copy scatter, shm in-place writes) never tick
+        in the agent process, so without them the pulse would carry
+        sidecar service ops and nothing else. New workers pre-aggregate
+        and ship sparse deltas via report_scope_delta instead."""
         if worker_id in self.workers:
             self._worker_scope[worker_id] = (counters, hists)
+
+    async def report_scope_delta(self, worker_id: bytes,
+                                 deltas: dict) -> None:
+        """graftpulse: a worker's PRE-AGGREGATED sparse scope deltas for
+        its last flush window (non-zero rows only). Banking is a plain
+        dict merge keyed by kind — bounded by the kind vocabulary, cheap
+        enough to run inline on receive — so the pulse tick's fold
+        shrinks to one merge of this bank instead of a per-source
+        cumulative-block normalization while dispatch is running."""
+        if worker_id not in self.workers:
+            return
+        from ray_tpu.core._native.graftpulse import merge_hists
+        bank = self._pulse_banked
+        for name, d in deltas.items():
+            dh = tuple(int(x) for x in d[3])
+            acc = bank.get(name)
+            if acc is None:
+                bank[name] = (int(d[0]), int(d[1]), int(d[2]), dh)
+            else:
+                bank[name] = (acc[0] + int(d[0]), acc[1] + int(d[1]),
+                              acc[2] + int(d[2]), merge_hists(acc[3], dh))
 
     async def report_prof(self, worker_id: bytes, payload: dict) -> None:
         """graftprof: one hosted worker's profile delta for the last
@@ -1261,6 +1305,90 @@ class NodeAgent:
     # leases (reference: cluster_lease_manager.cc QueueAndScheduleLease +
     # spillback ScheduleOnNode)
     # ------------------------------------------------------------------
+    def _mint_lease(self) -> dict:
+        self._lease_seq += 1
+        lease_id = self._lease_seq.to_bytes(8, "big") + \
+            self.node_id.binary()[:8]
+        return {"granted": True, "lease_id": lease_id,
+                "node_id": self.node_id.binary()}
+
+    def _mark_sched_dirty(self) -> None:
+        """graftsched: schedule ONE coalesced fire-and-forget resource
+        delta to the controller (ray_syncer-style broadcast). Grants and
+        returns between heartbeats otherwise leave the controller's
+        spillback view up to resource_broadcast_period_ms stale."""
+        if self._sched_sync_scheduled or self._shutdown:
+            return
+        self._sched_sync_scheduled = True
+        spawn(self._sched_delta_sync())
+
+    async def _sched_delta_sync(self) -> None:
+        try:
+            await asyncio.sleep(
+                max(0.0, GlobalConfig.sched_delta_ms / 1000))
+            self._sched_sync_scheduled = False
+            await self.controller.call(
+                "report_sched_delta", self.node_id.binary(),
+                dict(self.resources_available), len(self.leases))
+        except Exception:
+            self._sched_sync_scheduled = False  # next change re-arms
+
+    @long_poll
+    async def request_lease_batch(self, count: int, resources: dict,
+                                  pg: Optional[bytes] = None,
+                                  bundle_index: int = -1, strategy=None,
+                                  label_selector: Optional[dict] = None
+                                  ) -> dict:
+        """Grant up to ``count`` leases of ONE scheduling class in a
+        single RPC from the local resource view (reference: the raylet's
+        cluster_lease_manager grants locally and ray_syncer broadcasts
+        the delta — no per-lease control-plane round-trip). Grants stop
+        at the first local miss (no fit, no warm worker); zero grants
+        fall back to the single parked/spilling path so batch callers
+        inherit server-side parking and controller spillback."""
+        granted: list = []
+        count = max(1, int(count))
+        local_ok = pg is not None or (
+            labels_match(self.labels, label_selector)
+            and self._strategy_allows_local(strategy))
+        while local_ok and len(granted) < count:
+            avail = (self.bundle_available.get((pg, bundle_index))
+                     if pg is not None else self.resources_available)
+            if avail is None or not resources_fit(avail, resources):
+                break
+            # FIFO fairness vs already-parked single requests: a batch
+            # must not jump a satisfiable earlier waiter.
+            if self._lease_waiters and self._lease_head_blocked(
+                    self._lease_ticket_seq + 1, avail, pg, bundle_index):
+                break
+            if granted and not self.idle_workers:
+                # Only the first grant of a wave may wait on a worker
+                # spawn; the rest would serialize spawn latency behind
+                # one RPC. The client re-requests for the remainder.
+                break
+            resources_sub(avail, resources)
+            try:
+                w = await self._pop_worker()
+            except Exception:
+                resources_add(avail, resources)
+                break
+            r = self._mint_lease()
+            w.current_lease = r["lease_id"]
+            self.leases[r["lease_id"]] = (w, dict(resources), pg,
+                                          bundle_index)
+            r["worker_addr"] = w.addr
+            granted.append(r)
+        if granted:
+            self._mark_sched_dirty()
+            async with self._resource_cv:
+                self._resource_cv.notify_all()
+            return {"granted": granted}
+        r = await self.request_lease(resources, pg, bundle_index, strategy,
+                                     label_selector)
+        if r.get("granted"):
+            return {"granted": [r]}
+        return {"granted": [], "retry": True}
+
     @long_poll
     async def request_lease(self, resources: dict, pg: Optional[bytes] = None,
                             bundle_index: int = -1, strategy=None,
@@ -1365,14 +1493,13 @@ class NodeAgent:
                 except Exception as e:
                     resources_add(avail, resources)
                     return {"granted": False, "retry": True, "error": repr(e)}
-                self._lease_seq += 1
-                lease_id = self._lease_seq.to_bytes(8, "big") + \
-                    self.node_id.binary()[:8]
-                w.current_lease = lease_id
-                self.leases[lease_id] = (w, dict(resources), pg, bundle_index)
-                return {"granted": True, "lease_id": lease_id,
-                        "worker_addr": w.addr,
-                        "node_id": self.node_id.binary()}
+                r = self._mint_lease()
+                w.current_lease = r["lease_id"]
+                self.leases[r["lease_id"]] = (w, dict(resources), pg,
+                                              bundle_index)
+                r["worker_addr"] = w.addr
+                self._mark_sched_dirty()
+                return r
 
             if not _no_spill and pg is None:
                 # Spillback: ask the controller for a feasible node.
@@ -1430,6 +1557,7 @@ class NodeAgent:
         w.current_lease = None
         await self._return_resources(res, pg, bundle_index)
         self._push_idle(w)
+        self._mark_sched_dirty()
 
     # ------------------------------------------------------------------
     # placement group bundles (2-phase commit participant)
@@ -1462,6 +1590,35 @@ class NodeAgent:
         if res is not None:
             self.bundle_available.pop((pg_id, index), None)
             await self._free_resources(res)
+
+    async def prepare_commit_bundles(self, pg_id: bytes,
+                                     items: list) -> bool:
+        """graftsched one-op PG participant: prepare AND commit every
+        bundle this node hosts in ONE agent round, all-or-nothing. The
+        controller already planned against a consistent snapshot, so the
+        2-phase split buys nothing on the happy path — a local miss
+        rolls this node back here and the controller falls back to the
+        retrying 2-phase scheduler. ``items`` is [(index, resources)]."""
+        done: list = []
+        for index, resources in items:
+            if await self.prepare_bundle(pg_id, index, resources):
+                done.append(index)
+            else:
+                for i in done:
+                    await self.return_bundle(pg_id, i)
+                return False
+        for index in done:
+            await self.commit_bundle(pg_id, index)
+        self._mark_sched_dirty()
+        return True
+
+    async def return_bundles(self, pg_id: bytes, indices: list) -> None:
+        """Batched bundle release: one agent round per node on PG remove
+        (the per-bundle loop stays controller-side but coalesces into a
+        single RPC here)."""
+        for index in indices:
+            await self.return_bundle(pg_id, index)
+        self._mark_sched_dirty()
 
     # Reservations younger than this never reconcile away: the
     # controller's valid/pending sets are a snapshot and a prepare can
@@ -1637,14 +1794,22 @@ class NodeAgent:
         if drop > 0:
             del self._trail_objects[:drop]
 
-    async def report_trail(self, worker_id: bytes, events: list) -> None:
+    async def report_trail(self, worker_id: bytes, events: list,
+                           objects: Optional[list] = None) -> None:
         """Hosted workers hand their task-transition batches here (one
         unix-socket hop); the flush tick ships the node's whole batch to
-        the controller."""
+        the controller. ``objects`` carries owner-attested object events
+        — the graftsched 'inline' plane, whose objects never touch the
+        store so the journal cannot see them."""
         self._trail_tasks.extend(events)
         drop = len(self._trail_tasks) - self._trail_cap
         if drop > 0:
             del self._trail_tasks[:drop]
+        if objects:
+            self._trail_objects.extend(objects)
+            drop = len(self._trail_objects) - self._trail_cap
+            if drop > 0:
+                del self._trail_objects[:drop]
 
     async def trail_residents(self) -> list:
         """Hex oids this node currently holds (store primaries + spilled

@@ -97,6 +97,10 @@ _flag("worker_pool_max_idle_workers", int, 8, "Idle workers kept warm per node."
 _flag("worker_prestart", int, 0, "Workers to spawn at agent startup (reference: worker_pool.cc PrestartWorkers) — warm pools make burst workloads spawn-free.")
 _flag("locality_min_bytes", int, 128 * 1024, "Stored-arg bytes on a node before a task prefers leasing there (reference: lease_policy.cc locality-aware scheduling).")
 _flag("worker_pool_idle_ttl_s", int, 300, "Kill idle workers after this long.")
+_flag("graftsched", bool, True, "Lease-based scheduling fast path (graftsched): lease waves are granted in ONE batched agent RPC per wave (reference: cluster_lease_manager.cc grants locally, ray_syncer broadcasts the delta), drained lease runners park on a keep-alive TTL instead of returning the lease per burst, the agent syncs the controller with coalesced fire-and-forget resource deltas, and one-round placement-group create/remove folds prepare+commit into a single agent op per node. RAY_TPU_GRAFTSCHED=0 restores the per-op legacy paths.")
+_flag("graftsched_inline_bytes", int, 8192, "Small-object provenance threshold: results/puts at or under this size that ride inline in the reply frame (never touching the store) get owner-attested grafttrail object events on the 'inline' plane so `audit` still balances; larger inline objects stay untracked as before.")
+_flag("graftsched_keepalive_ms", int, 250, "Lease keep-alive: a drained lease runner holds its leased worker this long waiting for new same-class tasks before returning the lease (kills the request/return round-trip pair between bursts). 0 returns leases eagerly (legacy).")
+_flag("sched_delta_ms", int, 20, "Coalescing window for the agent's fire-and-forget scheduling-delta sync to the controller (lease grants/returns between heartbeats); keeps spillback picks fresh without per-grant RPCs.")
 
 # --- streaming generators ---
 _flag("streaming_generator_backpressure_items", int, 16, "Yielded-but-unconsumed items before the producer stalls (reference: generator_waiter.cc backpressure).")
