@@ -2,7 +2,7 @@
 # invocation verbatim); these targets are the pieces, runnable alone.
 
 .PHONY: lint lint-hotpath lint-native test fast native native-test \
-	bench-core bench-load
+	bench-core bench-load bench-scale
 
 # graftlint: framework-aware static analysis (event-loop safety, lock
 # discipline, Python<->C wire-schema drift, RPC signature drift, leaks,
@@ -49,3 +49,14 @@ bench-core:
 bench-load:
 	JAX_PLATFORMS=cpu python -m ray_tpu.cli soak --profile bench \
 		| tee BENCH_LOAD.json
+
+# graftscale: ramp simulated node agents (real graftrpc + wire-true
+# pulse/trail/log/prof traffic) against a real controller subprocess;
+# the controller's graftmeta plane self-meters every ingest path. One
+# JSON row per level / plane ceiling / verdict; exits non-zero when a
+# machine-checked bound (pulse-fold p99 < 50ms, loop lag, RSS/node)
+# fails. ~35s for the 64->256 ramp; the one-level <60s smoke shape
+# runs in CI via ci.sh.
+bench-scale:
+	JAX_PLATFORMS=cpu python bench_scale.py > BENCH_SCALE.json; \
+	rc=$$?; cat BENCH_SCALE.json; exit $$rc

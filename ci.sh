@@ -35,6 +35,17 @@ echo "=== stage 1: fast suite ==="
 # a worker mid-run, and asserts the SLO verdicts the planes report.
 python -m pytest tests/ -m fast -q
 
+echo "=== graftscale smoke ==="
+# One ~64-node level of the graftscale harness (<60s): simulated node
+# agents ship wire-true pulse/trail/log/prof traffic at a population
+# no real CI cluster reaches, and the controller's own graftmeta plane
+# must report pulse-fold p99 under the 50ms budget (plus bounded loop
+# lag / RSS per node). Exit code IS the verdict gate; BENCH_SCALE.json
+# is the committed full-ramp scoreboard (make bench-scale).
+JAX_PLATFORMS=cpu python bench_scale.py --smoke > /tmp/_scale_smoke.json
+grep -q '"check": "pulse_fold_p99_bounded", "ok": true' \
+    /tmp/_scale_smoke.json
+
 echo "=== stage 2: slow suites (chunked) ==="
 python -m pytest tests/test_chaos.py tests/test_oom.py \
     tests/test_spilling.py tests/test_gcs_ft.py -q

@@ -158,12 +158,15 @@ pthread_t g_sampler{};
 int g_sampler_started = 0;  // under g_start_lock
 SpinLock g_start_lock;
 
-void SampleTick(uint64_t tick, uint64_t now_ns, uint64_t period_ns) {
+// Returns true when any registered thread burned CPU this tick — the
+// idle-backoff signal for the sampler loop.
+bool SampleTick(uint64_t tick, uint64_t now_ns, uint64_t period_ns) {
   EmitRec(kProfTick, 0, 0,
           (uint32_t)(period_ns / 1000 > 0xFFFFFFFFull
                          ? 0xFFFFFFFFull
                          : period_ns / 1000),
           tick, now_ns);
+  bool active = false;
   int slots = g_high_water.load(std::memory_order_acquire);
   for (int s = 0; s < slots; s++) {
     ProfThread* t = &g_threads[s];
@@ -180,6 +183,9 @@ void SampleTick(uint64_t tick, uint64_t now_ns, uint64_t period_ns) {
     uint64_t d = cpu > t->last_cpu_ns ? cpu - t->last_cpu_ns : 0;
     t->last_cpu_ns = cpu;
     t->cum_cpu_ns.fetch_add(d, std::memory_order_relaxed);
+    // A delta under ~100us over a whole period is scheduler noise (the
+    // sampler's own bookkeeping shows up here), not workload.
+    if (d > 100000ull) active = true;
     uint64_t d_us = d / 1000;
     EmitRec(kProfThreadCpu, (uint8_t)s, 0,
             (uint32_t)(d_us > 0xFFFFFFFFull ? 0xFFFFFFFFull : d_us),
@@ -207,28 +213,44 @@ void SampleTick(uint64_t tick, uint64_t now_ns, uint64_t period_ns) {
             (uint32_t)(w_us > 0xFFFFFFFFull ? 0xFFFFFFFFull : w_us),
             tick, NowNs());
   }
+  return active;
 }
+
+// Idle ticks stretch the sleep exponentially (1, 2, 4, 8, 16 periods);
+// one active tick snaps back to full rate. On a core-starved host the
+// wakeups themselves are the profiler's cost — a parked worker at the
+// default 67 Hz was paying 75 context switches a second (67 ticks +
+// 8 GIL probes) to observe nothing. The CPU-delta totals stay exact
+// across stretched sleeps (they are cumulative clocks, not samples),
+// only the reporting granularity coarsens while idle.
+constexpr uint64_t kIdleStretchMax = 16;
 
 void* SamplerLoop(void*) {
   prof_register_thread("graftprof-sampler");
   uint64_t last_ns = NowNs();
+  uint64_t idle = 0;
   while (g_run.load(std::memory_order_acquire)) {
     int hz = g_hz.load(std::memory_order_relaxed);
     if (hz <= 0) hz = kProfDefaultHz;
     uint64_t period_ns = 1000000000ull / (uint64_t)hz;
+    uint64_t stretch = idle < 4 ? (1ull << idle) : kIdleStretchMax;
+    uint64_t sleep_ns = period_ns * stretch;
     timespec req;
-    req.tv_sec = (time_t)(period_ns / 1000000000ull);
-    req.tv_nsec = (long)(period_ns % 1000000000ull);
+    req.tv_sec = (time_t)(sleep_ns / 1000000000ull);
+    req.tv_nsec = (long)(sleep_ns % 1000000000ull);
     nanosleep(&req, nullptr);
     if (!g_run.load(std::memory_order_acquire)) break;
     if (prof_enabled()) {
       uint64_t now = NowNs();
       uint64_t tick =
           g_ticks.fetch_add(1, std::memory_order_relaxed) + 1;
-      SampleTick(tick, now, now > last_ns ? now - last_ns : period_ns);
+      bool active =
+          SampleTick(tick, now, now > last_ns ? now - last_ns : period_ns);
       last_ns = now;
+      idle = active ? 0 : idle + 1;
     } else {
       last_ns = NowNs();  // keep the next period honest after re-enable
+      idle = idle + 1;    // disabled is as idle as it gets
     }
   }
   return nullptr;

@@ -235,17 +235,28 @@ int TestWraparound() {
   // Without a drainer the ring laps: several records per tick at
   // 997 Hz overflow kProfRingCap well inside the window. Losses are
   // accounted when a drain detects the lap (same as the scope rings),
-  // so poll via DrainOnce.
+  // so poll via DrainOnce. A registered thread burns CPU throughout
+  // the window — an idle process no longer ticks at full rate (the
+  // sampler stretches its sleep up to 16x), and ring overflow is an
+  // under-load phenomenon anyway.
   Drain();
   uint64_t dropped0 = prof_dropped();
   uint64_t ticks0 = prof_ticks();
   prof_start(997);  // raises the rate of the running sampler
+  std::atomic<bool> stop{false};
+  std::thread hot([&] {
+    prof_register_thread("wrap-hot");
+    volatile uint64_t sink = 0;
+    while (!stop.load(std::memory_order_relaxed)) sink += 1;
+  });
   uint64_t deadline = MonoNs() + 8000ull * 1000 * 1000;
   // Let the sampler produce > 2x the ring capacity worth of ticks
-  // (>= 3 records per tick: tick marker + sampler + main), then drain.
+  // (>= 3 records per tick: tick marker + sampler + hot), then drain.
   while (MonoNs() < deadline && prof_ticks() - ticks0 < 2 * kProfRingCap) {
     SleepMs(50);
   }
+  stop.store(true);
+  hot.join();
   DrainOnce();
   CHECK(prof_dropped() > dropped0);
   // The drain still yields only well-formed records from the fresh

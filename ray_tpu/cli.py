@@ -5,7 +5,7 @@ Analogue of the reference's CLI (reference: python/ray/scripts/scripts.py
 
     python -m ray_tpu.cli start --head [--resources '{"CPU": 8}']
     python -m ray_tpu.cli start --address HOST:PORT      # join as a node
-    python -m ray_tpu.cli status --address HOST:PORT [--live]
+    python -m ray_tpu.cli status --address HOST:PORT [--live|--planes]
     python -m ray_tpu.cli list actors|nodes|tasks|workers|objects ...
     python -m ray_tpu.cli list tasks --state FAILED --node ID ...
     python -m ray_tpu.cli summary tasks --address ...
@@ -80,6 +80,8 @@ def cmd_status(args) -> int:
     from ray_tpu import state
     if getattr(args, "live", False):
         return _status_live(args.interval)
+    if getattr(args, "planes", False):
+        return _status_planes()
     s = state.cluster_summary()
     print(f"nodes: {s['nodes_alive']}/{s['nodes_total']} alive; "
           f"actors: {s['actors']}")
@@ -87,6 +89,64 @@ def cmd_status(args) -> int:
     for k, total in sorted(s["resources_total"].items()):
         avail = s["resources_available"].get(k, 0)
         print(f"  {k}: {avail:g}/{total:g} available")
+    return 0
+
+
+def _status_planes() -> int:
+    """graftmeta one-shot: how the observability planes themselves are
+    doing at the controller — ingest rates, fold-latency percentiles,
+    store occupancy, event-loop lag and RSS. The singleton-aggregator
+    failure mode (Ray's GCS under cardinality) is invisible from the
+    outside until nodes start dying; this is the gauge for it."""
+    from ray_tpu import state
+    m = state.meta_snapshot()
+    if not m.get("enabled"):
+        print("graftmeta is disabled (RAY_TPU_GRAFTMETA=0)")
+        return 1
+    lag = m.get("loop_lag", {})
+    print(f"controller — up {m.get('uptime_s', 0):.0f}s · "
+          f"rss {m.get('rss_bytes', 0) / 2**20:.1f} MiB · "
+          f"loop lag p50 {lag.get('p50_ns', 0) / 1e6:.2f}ms "
+          f"p99 {lag.get('p99_ns', 0) / 1e6:.2f}ms "
+          f"max {lag.get('max_ns', 0) / 1e6:.2f}ms   "
+          f"(window {m.get('window_s', 0):.0f}s)")
+    print(f"{'plane':<10}{'rec/s':>9}{'KiB/s':>9}{'batches':>9}"
+          f"{'drops':>7}{'fold p50':>10}{'fold p99':>10}"
+          f"{'fold total':>12}")
+    for plane, row in m.get("planes", {}).items():
+        print(f"{plane:<10}{row.get('records_per_s', 0):>9.1f}"
+              f"{row.get('bytes_per_s', 0) / 1024:>9.1f}"
+              f"{row.get('batches', 0):>9}"
+              f"{row.get('drops', 0):>7}"
+              f"{row.get('fold_p50_ns', 0) / 1e3:>9.0f}u"
+              f"{row.get('fold_p99_ns', 0) / 1e3:>9.0f}u"
+              f"{row.get('fold_ms_total', 0):>10.1f}ms")
+    stores = m.get("stores", {})
+    if stores:
+        print("\nstore occupancy:")
+        pulse = stores.get("pulse", {})
+        print(f"  pulse: {pulse.get('nodes', 0)} nodes · "
+              f"{pulse.get('pulses', 0)} pulses retained")
+        trail = stores.get("trail", {})
+        print(f"  trail: {trail.get('tasks', 0)} tasks · "
+              f"{trail.get('objects', 0)} objects · "
+              f"dropped {trail.get('dropped_tasks', 0)}/"
+              f"{trail.get('dropped_objects', 0)}")
+        prof = stores.get("prof", {})
+        print(f"  prof:  {prof.get('tasks', 0)} tasks · "
+              f"{prof.get('windows', 0)} windows · "
+              f"{prof.get('nodes', 0)} nodes"
+              + (f" · {prof['shards']} shards"
+                 if prof.get("shards") else ""))
+        log = stores.get("log", {})
+        print(f"  log:   {log.get('records', 0)}/{log.get('cap', 0)} "
+              f"records · evicted {log.get('evicted', 0)} · "
+              f"deduped {log.get('deduped', 0)} · "
+              f"suppressed {log.get('suppressed', 0)}"
+              + (f" · {log['shards']} shards"
+                 if log.get("shards") else ""))
+        scope = stores.get("scope", {})
+        print(f"  scope: {scope.get('spans', 0)} spans retained")
     return 0
 
 
@@ -571,6 +631,10 @@ def main(argv=None) -> int:
                          "plane (Ctrl-C to exit)")
     sp.add_argument("--interval", type=float, default=2.0,
                     help="refresh period for --live, seconds")
+    sp.add_argument("--planes", action="store_true",
+                    help="graftmeta self-telemetry: per-plane ingest "
+                         "rates, fold latency, store occupancy, "
+                         "controller loop lag + RSS")
     sp.set_defaults(fn=cmd_status)
 
     for name, fn in (("metrics", cmd_metrics), ("stop", cmd_stop)):

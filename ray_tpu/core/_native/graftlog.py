@@ -46,6 +46,7 @@ False)`` turns the plane off; everything degrades to no-ops.
 from __future__ import annotations
 
 import ctypes
+import itertools
 import logging
 import mmap
 import os
@@ -53,6 +54,7 @@ import struct
 import sys
 import threading
 import time
+import zlib
 from collections import OrderedDict, deque
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
@@ -686,12 +688,17 @@ class LogStore:
     salvage overlaps it."""
 
     def __init__(self, cap: int = 20000, rate_per_s: float = 200.0,
-                 dedup_window_s: float = 5.0):
+                 dedup_window_s: float = 5.0, id_alloc=None):
         self.cap = max(100, int(cap))
         self.rate_per_s = float(rate_per_s)
         self.dedup_window_s = float(dedup_window_s)
         self._recs: "OrderedDict[int, dict]" = OrderedDict()
-        self._next_id = 1
+        # Row ids must be globally monotonic even when several shard
+        # stores share the table (ShardedLogStore injects one shared
+        # counter; itertools.count.__next__ is atomic under the GIL, so
+        # cross-shard allocation needs no extra lock).
+        self._id_alloc = id_alloc if id_alloc is not None \
+            else itertools.count(1).__next__
         self._by_task: Dict[str, set] = {}
         self._by_actor: Dict[str, set] = {}
         self._by_node: Dict[str, set] = {}
@@ -730,6 +737,17 @@ class LogStore:
             if row["level"] < logging.WARNING and not row["salvaged"]:
                 victim = rid
                 break
+        if victim is None and self._by_node:
+            # Only WARNING+/salvaged rows left: cardinality fairness —
+            # take the oldest non-salvaged row of the NOISIEST node, so
+            # one node's warning storm reclaims its own space instead
+            # of rolling every other node's errors out of the store.
+            noisiest = max(self._by_node,
+                           key=lambda k: len(self._by_node[k]))
+            for rid in sorted(self._by_node[noisiest]):
+                if not self._recs[rid]["salvaged"]:
+                    victim = rid
+                    break
         if victim is None:
             for rid, row in self._recs.items():
                 if not row["salvaged"]:
@@ -752,8 +770,7 @@ class LogStore:
                     del idx[key]
 
     def _insert(self, row: dict) -> None:
-        rid = self._next_id
-        self._next_id += 1
+        rid = self._id_alloc()
         row["id"] = rid
         self._recs[rid] = row
         for idx, key in ((self._by_task, row["task"]),
@@ -917,3 +934,76 @@ class LogStore:
                     "tasks": len(self._by_task),
                     "nodes": len(self._by_node),
                     "by_level": by_level}
+
+
+class ShardedLogStore:
+    """Node-hash partitioned LogStore: N independent stores, each with
+    its own lock, indexes, eviction and cap slice, routed by
+    ``crc32(node) % N``.
+
+    What the scale harness showed at 256+ nodes is the classic
+    singleton-store shape: every agent batch serialized through one
+    lock, and one node's eviction pressure scanning (and evicting)
+    every other node's rows. Sharding makes both per-partition —
+    ingest for node A never contends with node B's, and a noisy
+    shard's eviction churn is bounded by its own cap slice.
+
+    Row ids stay *globally* monotonic (one shared allocator injected
+    into every shard), which is the invariant the merged ``list()``
+    and its ``after_id`` follow-cursor semantics ride on: per-shard
+    tails merge-sort by id straight back into cluster time order."""
+
+    def __init__(self, shards: int = 8, cap: int = 20000,
+                 rate_per_s: float = 200.0, dedup_window_s: float = 5.0):
+        n = max(1, int(shards))
+        self.cap = max(100, int(cap))
+        alloc = itertools.count(1).__next__
+        self.shards = [LogStore(cap=max(100, self.cap // n),
+                                rate_per_s=rate_per_s,
+                                dedup_window_s=dedup_window_s,
+                                id_alloc=alloc)
+                       for _ in range(n)]
+
+    def _shard(self, node: str) -> LogStore:
+        return self.shards[zlib.crc32(node.encode()) % len(self.shards)]
+
+    def ingest_batch(self, node: str, records: List[dict],
+                     salvaged: bool = False) -> int:
+        return self._shard(node).ingest_batch(node, records,
+                                              salvaged=salvaged)
+
+    def list(self, task: str = "", actor: str = "", node: str = "",
+             level: int = 0, since_ns: int = 0, after_id: int = 0,
+             limit: int = 100) -> List[dict]:
+        if node:  # node filter pins the shard — no fan-out
+            return self._shard(node).list(task=task, actor=actor,
+                                          node=node, level=level,
+                                          since_ns=since_ns,
+                                          after_id=after_id, limit=limit)
+        limit = max(1, int(limit))
+        rows: List[dict] = []
+        for s in self.shards:
+            rows.extend(s.list(task=task, actor=actor, level=level,
+                               since_ns=since_ns, after_id=after_id,
+                               limit=limit))
+        rows.sort(key=lambda r: r["id"])
+        return rows[-limit:]
+
+    def task_tail(self, task: str, limit: int = 20) -> List[dict]:
+        return self.list(task=task, limit=limit)
+
+    def stats(self) -> dict:
+        out = {"records": 0, "cap": self.cap, "ingested": 0,
+               "suppressed": 0, "deduped": 0, "evicted": 0,
+               "salvaged": 0, "tasks": 0, "nodes": 0,
+               "by_level": {}, "shards": len(self.shards),
+               "shard_records": []}
+        for s in self.shards:
+            st = s.stats()
+            for k in ("records", "ingested", "suppressed", "deduped",
+                      "evicted", "salvaged", "tasks", "nodes"):
+                out[k] += st[k]
+            for name, cnt in st["by_level"].items():
+                out["by_level"][name] = out["by_level"].get(name, 0) + cnt
+            out["shard_records"].append(st["records"])
+        return out

@@ -48,6 +48,7 @@ import struct
 import sys
 import threading
 import time
+import zlib
 from collections import OrderedDict, deque
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
@@ -415,9 +416,14 @@ class _PySampler(threading.Thread):
                     sampled = True
         return sampled
 
-    # Idle ticks stretch the next sleep up to this many periods, so a
-    # parked process wakes ~8x less often; one busy tick snaps back.
-    _IDLE_BACKOFF_MAX = 8
+    # Idle ticks stretch the next sleep exponentially (1, 2, 4, ...
+    # periods) up to this many periods, so a parked process reaches its
+    # floor wake rate after 5 idle ticks (~75ms at the default 67 Hz)
+    # instead of ramping linearly through 8; one busy tick snaps back.
+    # On a core-starved host the wakeups themselves are the overhead —
+    # every sampler tick is a context switch stolen from the workload —
+    # so how FAST the backoff engages matters as much as its ceiling.
+    _IDLE_BACKOFF_MAX = 16
 
     # Overhead governor: the sampler may spend at most this fraction
     # of the process's own CPU time, measured as an EWMA of
@@ -444,7 +450,8 @@ class _PySampler(threading.Thread):
         last_proc = time.process_time_ns()
         last_self = time.thread_time_ns()
         while not self._stop.wait(
-                self.period * min(self._IDLE_BACKOFF_MAX, 1 + idle)
+                self.period
+                * min(self._IDLE_BACKOFF_MAX, 1 << min(idle, 4))
                 * throttle):
             try:
                 sampled = self.sample_once()
@@ -655,6 +662,68 @@ def _merge_folded(dst: Dict[str, int], src: Dict[str, int],
             del dst[stack]
 
 
+# Renderers over a folded {stack: count} dict, shared by ProfStore and
+# ShardedProfStore (the sharded store merges per-shard folds first and
+# renders once — selection is per-partition, presentation is global).
+
+def _top_from_folded(folded: Dict[str, int], native: Dict[str, int],
+                     limit: int = 30) -> dict:
+    total = sum(folded.values())
+    self_n: Dict[str, int] = {}
+    cum_n: Dict[str, int] = {}
+    for stack, n in folded.items():
+        parts = stack.split(";")
+        if not parts:
+            continue
+        leaf = parts[-1]
+        self_n[leaf] = self_n.get(leaf, 0) + n
+        for fr in set(parts):
+            cum_n[fr] = cum_n.get(fr, 0) + n
+    rows = []
+    for fr in sorted(self_n, key=lambda f: (-self_n[f], f)):
+        rows.append({"func": fr, "self": self_n[fr],
+                     "cum": cum_n.get(fr, 0),
+                     "self_pct": 100.0 * self_n[fr] / total
+                     if total else 0.0,
+                     "cum_pct": 100.0 * cum_n.get(fr, 0) / total
+                     if total else 0.0})
+        if len(rows) >= max(1, limit):
+            break
+    return {"total_samples": total, "rows": rows,
+            "native_threads": sorted(native.items(),
+                                     key=lambda kv: -kv[1])}
+
+
+def _flame_from_folded(folded: Dict[str, int]) -> dict:
+    root = {"name": "all", "value": 0, "children": {}}
+    for stack, n in folded.items():
+        root["value"] += n
+        cur = root
+        for fr in stack.split(";"):
+            child = cur["children"].get(fr)
+            if child is None:
+                child = cur["children"][fr] = {
+                    "name": fr, "value": 0, "children": {}}
+            child["value"] += n
+            cur = child
+
+    def _materialize(node_: dict) -> dict:
+        kids = [_materialize(c) for c in node_["children"].values()]
+        kids.sort(key=lambda c: -c["value"])
+        out = {"name": node_["name"], "value": node_["value"]}
+        if kids:
+            out["children"] = kids
+        return out
+
+    return _materialize(root)
+
+
+def _collapsed_from_folded(folded: Dict[str, int]) -> List[str]:
+    return ["%s %d" % (stack, n)
+            for stack, n in sorted(folded.items(),
+                                   key=lambda kv: -kv[1])]
+
+
 class ProfStore:
     """Bounded per-node / per-task profile store (controller-owned).
 
@@ -782,78 +851,36 @@ class ProfStore:
                         out[stack] = out.get(stack, 0) + n
         return out
 
-    def top(self, task: str = "", actor: str = "", node: str = "",
-            seconds: float = 0.0, limit: int = 30) -> dict:
-        """Per-function self/cumulative sample counts: the leaf frame
-        of a stack earns self time, every distinct frame on it earns
-        cumulative time."""
-        folded = self._select(task, actor, node, seconds)
-        total = sum(folded.values())
-        self_n: Dict[str, int] = {}
-        cum_n: Dict[str, int] = {}
-        for stack, n in folded.items():
-            parts = stack.split(";")
-            if not parts:
-                continue
-            leaf = parts[-1]
-            self_n[leaf] = self_n.get(leaf, 0) + n
-            for fr in set(parts):
-                cum_n[fr] = cum_n.get(fr, 0) + n
-        rows = []
-        for fr in sorted(self_n, key=lambda f: (-self_n[f], f)):
-            rows.append({"func": fr, "self": self_n[fr],
-                         "cum": cum_n.get(fr, 0),
-                         "self_pct": 100.0 * self_n[fr] / total
-                         if total else 0.0,
-                         "cum_pct": 100.0 * cum_n.get(fr, 0) / total
-                         if total else 0.0})
-            if len(rows) >= max(1, limit):
-                break
-        # Native thread CPU is process-wide, not task-attributable —
-        # report it alongside so C-plane time is visible, not lost.
+    def _native_threads(self, node: str = "") -> Dict[str, int]:
+        """Native thread CPU is process-wide, not task-attributable —
+        reported alongside so C-plane time is visible, not lost."""
         native: Dict[str, int] = {}
         with self._lock:
             for nid in ([node] if node else list(self._threads)):
                 for name, ns in self._threads.get(nid, {}).items():
                     native[name] = native.get(name, 0) + ns
-        return {"total_samples": total, "rows": rows,
-                "native_threads": sorted(native.items(),
-                                         key=lambda kv: -kv[1])}
+        return native
+
+    def top(self, task: str = "", actor: str = "", node: str = "",
+            seconds: float = 0.0, limit: int = 30) -> dict:
+        """Per-function self/cumulative sample counts: the leaf frame
+        of a stack earns self time, every distinct frame on it earns
+        cumulative time."""
+        return _top_from_folded(self._select(task, actor, node, seconds),
+                                self._native_threads(node), limit)
 
     def flame(self, task: str = "", actor: str = "", node: str = "",
               seconds: float = 0.0) -> dict:
         """d3-flamegraph JSON: nested {name, value, children}."""
-        folded = self._select(task, actor, node, seconds)
-        root = {"name": "all", "value": 0, "children": {}}
-        for stack, n in folded.items():
-            root["value"] += n
-            cur = root
-            for fr in stack.split(";"):
-                child = cur["children"].get(fr)
-                if child is None:
-                    child = cur["children"][fr] = {
-                        "name": fr, "value": 0, "children": {}}
-                child["value"] += n
-                cur = child
-
-        def _materialize(node_: dict) -> dict:
-            kids = [_materialize(c) for c in node_["children"].values()]
-            kids.sort(key=lambda c: -c["value"])
-            out = {"name": node_["name"], "value": node_["value"]}
-            if kids:
-                out["children"] = kids
-            return out
-
-        return _materialize(root)
+        return _flame_from_folded(
+            self._select(task, actor, node, seconds))
 
     def collapsed(self, task: str = "", actor: str = "", node: str = "",
                   seconds: float = 0.0) -> List[str]:
         """Brendan-Gregg collapsed format: one "a;b;c N" line per
         distinct stack (flamegraph.pl / speedscope input)."""
-        folded = self._select(task, actor, node, seconds)
-        return ["%s %d" % (stack, n)
-                for stack, n in sorted(folded.items(),
-                                       key=lambda kv: -kv[1])]
+        return _collapsed_from_folded(
+            self._select(task, actor, node, seconds))
 
     def task_stats(self, task: str = "", actor: str = "") -> dict:
         """Per-task totals for the grafttrail join (`get task`)."""
@@ -874,3 +901,89 @@ class ProfStore:
                     "nodes": len(self._nodes),
                     "windows": sum(len(r) for r in self._nodes.values()),
                     "ingested": self.ingested}
+
+
+class ShardedProfStore:
+    """Node-hash partitioned ProfStore: ingest and forget route by
+    ``crc32(node) % N`` into N independent stores (own lock, own node
+    ring, own task LRU slice); queries merge per-shard folds and render
+    once through the shared ``_*_from_folded`` helpers.
+
+    Payload merge is the ProfStore hot path at cardinality — every
+    flush window walks its stacks under the store lock, so a singleton
+    store serializes all nodes' merges. A task that ran attempts on
+    several nodes has partial profiles in several shards;
+    ``task_stats`` sums them back together."""
+
+    def __init__(self, shards: int = 8, history: int = 120,
+                 task_cap: int = 512, stack_cap: int = 256):
+        n = max(1, int(shards))
+        self.shards = [ProfStore(history=history,
+                                 task_cap=max(8, int(task_cap) // n),
+                                 stack_cap=stack_cap)
+                       for _ in range(n)]
+
+    def _shard(self, node_id: str) -> ProfStore:
+        return self.shards[zlib.crc32(node_id.encode())
+                           % len(self.shards)]
+
+    def ingest(self, node_id: str, payload: dict,
+               wall_s: Optional[float] = None) -> None:
+        self._shard(node_id).ingest(node_id, payload, wall_s)
+
+    def forget_node(self, node_id: str) -> None:
+        self._shard(node_id).forget_node(node_id)
+
+    def _merged(self, task: str, actor: str, node: str,
+                seconds: float) -> Dict[str, int]:
+        shards = [self._shard(node)] if node else self.shards
+        out: Dict[str, int] = {}
+        for s in shards:
+            for stack, n in s._select(task, actor, node,
+                                      seconds).items():
+                out[stack] = out.get(stack, 0) + n
+        return out
+
+    def top(self, task: str = "", actor: str = "", node: str = "",
+            seconds: float = 0.0, limit: int = 30) -> dict:
+        shards = [self._shard(node)] if node else self.shards
+        native: Dict[str, int] = {}
+        for s in shards:
+            for name, ns in s._native_threads(node).items():
+                native[name] = native.get(name, 0) + ns
+        return _top_from_folded(self._merged(task, actor, node, seconds),
+                                native, limit)
+
+    def flame(self, task: str = "", actor: str = "", node: str = "",
+              seconds: float = 0.0) -> dict:
+        return _flame_from_folded(
+            self._merged(task, actor, node, seconds))
+
+    def collapsed(self, task: str = "", actor: str = "", node: str = "",
+                  seconds: float = 0.0) -> List[str]:
+        return _collapsed_from_folded(
+            self._merged(task, actor, node, seconds))
+
+    def task_stats(self, task: str = "", actor: str = "") -> dict:
+        out: dict = {}
+        for s in self.shards:
+            st = s.task_stats(task, actor)
+            if not st:
+                continue
+            if not out:
+                out = dict(st)
+            else:
+                for k in ("samples", "oncpu_ns", "gil_ns", "wall_ns"):
+                    out[k] += st[k]
+                if not out.get("name"):
+                    out["name"] = st.get("name", "")
+        return out
+
+    def stats(self) -> dict:
+        out = {"tasks": 0, "nodes": 0, "windows": 0, "ingested": 0,
+               "shards": len(self.shards)}
+        for s in self.shards:
+            st = s.stats()
+            for k in ("tasks", "nodes", "windows", "ingested"):
+                out[k] += st[k]
+        return out

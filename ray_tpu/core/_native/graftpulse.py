@@ -82,6 +82,14 @@ PULSE_RECORD_FIELDS = (
 PULSE_RECORD = struct.Struct("<IHHQQQQQIIQIIQQQII")
 PULSE_RECORD_SIZE = 104
 
+# Version 1 header: version 2 minus the two trailing graftprof gauges.
+# Kept decodable forever — a rolling upgrade means the controller WILL
+# see old-format frames, and a version mismatch must degrade that one
+# node's row, not get the node declared dead for "pulse silence".
+_V1_RECORD = struct.Struct("<IHHQQQQQIIQIIQQQ")
+assert _V1_RECORD.size == PULSE_VERSION_SIZES[1]
+assert PULSE_RECORD.size == PULSE_VERSION_SIZES[2] == PULSE_RECORD_SIZE
+
 _ROW_WORDS = 3 + PULSE_HIST_BUCKETS  # calls, bytes, ns, b0..b15
 
 
@@ -105,6 +113,10 @@ class Pulse(NamedTuple):
     prof_gil_permille: int
     # kind_name -> (calls, bytes, ns, (b0..b15)) — deltas for this tick.
     kinds: Dict[str, Tuple[int, int, int, Tuple[int, ...]]]
+    # Wire version the frame arrived in (PULSE_VERSION for local
+    # assembly; older registry versions survive decode with their
+    # missing fields zeroed so the fold can mark the node degraded).
+    version: int = PULSE_VERSION
 
 
 def enabled() -> bool:
@@ -152,25 +164,40 @@ def encode(p: Pulse) -> bytes:
 
 def decode(buf: bytes) -> Pulse:
     """Inverse of encode(). Raises ValueError on a malformed or
-    version-skewed frame (the controller drops those, it never dies on
-    them)."""
-    if len(buf) < PULSE_RECORD_SIZE:
+    unknown-version frame (the controller drops those, it never dies on
+    them). Every version in PULSE_VERSION_SIZES decodes: missing fields
+    zero-fill and the returned Pulse carries its wire version so the
+    aggregator can mark the node's row degraded instead of letting a
+    skewed-but-healthy node rot into pulse-silence death."""
+    if len(buf) < 8:
         raise ValueError("pulse frame truncated")
-    (magic, version, kind_count, seq, t_mono_ns, t_wall_ns, store_used,
-     store_capacity, store_objects, shm_free_chunks, shm_arena_bytes,
-     num_workers, queue_depth, rss_bytes, scope_dropped, events_dropped,
-     prof_oncpu_permille, prof_gil_permille) = \
-        PULSE_RECORD.unpack_from(buf, 0)
+    magic, version, kind_count = struct.unpack_from("<IHH", buf, 0)
     if magic != PULSE_MAGIC:
         raise ValueError("bad pulse magic 0x%x" % magic)
-    if version != PULSE_VERSION:
-        raise ValueError("pulse version skew: %d != %d"
-                         % (version, PULSE_VERSION))
-    need = PULSE_RECORD_SIZE + kind_count * _ROW_WORDS * 8
+    head_size = PULSE_VERSION_SIZES.get(version)
+    if head_size is None:
+        raise ValueError("pulse version skew: %d not in %s"
+                         % (version, sorted(PULSE_VERSION_SIZES)))
+    if len(buf) < head_size:
+        raise ValueError("pulse frame truncated")
+    if version == PULSE_VERSION:
+        (magic, version, kind_count, seq, t_mono_ns, t_wall_ns,
+         store_used, store_capacity, store_objects, shm_free_chunks,
+         shm_arena_bytes, num_workers, queue_depth, rss_bytes,
+         scope_dropped, events_dropped,
+         prof_oncpu_permille, prof_gil_permille) = \
+            PULSE_RECORD.unpack_from(buf, 0)
+    else:  # v1: no graftprof gauges on the wire
+        (magic, version, kind_count, seq, t_mono_ns, t_wall_ns,
+         store_used, store_capacity, store_objects, shm_free_chunks,
+         shm_arena_bytes, num_workers, queue_depth, rss_bytes,
+         scope_dropped, events_dropped) = _V1_RECORD.unpack_from(buf, 0)
+        prof_oncpu_permille = prof_gil_permille = 0
+    need = head_size + kind_count * _ROW_WORDS * 8
     if len(buf) < need:
         raise ValueError("pulse payload truncated")
     words = struct.unpack_from("<%dQ" % (kind_count * _ROW_WORDS), buf,
-                               PULSE_RECORD_SIZE)
+                               head_size)
     kinds: Dict[str, Tuple[int, int, int, Tuple[int, ...]]] = {}
     for kind in range(kind_count):
         name = graftscope.KIND_NAMES.get(kind)
@@ -185,7 +212,7 @@ def decode(buf: bytes) -> Pulse:
                  store_objects, shm_free_chunks, shm_arena_bytes,
                  num_workers, queue_depth, rss_bytes, scope_dropped,
                  events_dropped, prof_oncpu_permille, prof_gil_permille,
-                 kinds)
+                 kinds, version)
 
 
 # --- histogram math -------------------------------------------------------
@@ -347,6 +374,7 @@ class NodeSeries:
         self.last_seq = 0
         self.missed_ticks = 0
         self.health = "alive"     # alive | suspect (dead nodes drop out)
+        self.wire_version = PULSE_VERSION
 
     def ingest(self, p: Pulse, rx_mono: float) -> None:
         self.pulses.append(p)
@@ -354,6 +382,7 @@ class NodeSeries:
         self.last_seq = p.seq
         self.missed_ticks = 0
         self.health = "alive"
+        self.wire_version = p.version
 
     def latest(self) -> Optional[Pulse]:
         return self.pulses[-1] if self.pulses else None
@@ -423,7 +452,14 @@ class ClusterAggregator:
                     "shm_arena_bytes": last.shm_arena_bytes,
                     "prof_oncpu_permille": last.prof_oncpu_permille,
                     "prof_gil_permille": last.prof_gil_permille,
+                    "wire_version": s.wire_version,
                 }
+                if s.wire_version != PULSE_VERSION:
+                    # Old-format node: its kind deltas still fold (they
+                    # are real data) but fields absent from its wire
+                    # version read as zero — flag the row so status/
+                    # dashboards don't misread zeros as idle.
+                    nodes[node_id]["degraded"] = True
             if len(w) >= 2:
                 span_s = max(span_s,
                              (w[-1].t_mono_ns - w[0].t_mono_ns) / 1e9)

@@ -151,10 +151,18 @@ class Controller:
         # forward their workers' folded-stack deltas fire-and-forget
         # (report_prof_batch); merges are add-only so a lost batch
         # loses a window, never corrupts a fold.
-        from ray_tpu.core._native.graftprof import ProfStore
-        self.prof = ProfStore(history=GlobalConfig.prof_history,
-                              task_cap=GlobalConfig.prof_task_cap,
-                              stack_cap=GlobalConfig.prof_stack_cap)
+        from ray_tpu.core._native.graftprof import (ProfStore,
+                                                    ShardedProfStore)
+        prof_shards = max(1, GlobalConfig.prof_shards)
+        if prof_shards > 1:
+            self.prof = ShardedProfStore(
+                shards=prof_shards, history=GlobalConfig.prof_history,
+                task_cap=GlobalConfig.prof_task_cap,
+                stack_cap=GlobalConfig.prof_stack_cap)
+        else:
+            self.prof = ProfStore(history=GlobalConfig.prof_history,
+                                  task_cap=GlobalConfig.prof_task_cap,
+                                  stack_cap=GlobalConfig.prof_stack_cap)
         # graftlog: bounded, indexed cluster log store. Agents tail
         # their workers' crash-persistent rings and ship coalesced
         # batches fire-and-forget (report_log_batch); a dead worker's
@@ -162,10 +170,30 @@ class Controller:
         # grafttrail attempt record as root-cause context. Dead nodes
         # are deliberately NOT forgotten — their last records are the
         # forensics payload.
-        from ray_tpu.core._native.graftlog import LogStore
-        self.logs = LogStore(cap=GlobalConfig.log_cap,
-                             rate_per_s=GlobalConfig.log_rate_per_s,
-                             dedup_window_s=GlobalConfig.log_dedup_window_s)
+        from ray_tpu.core._native.graftlog import (LogStore,
+                                                   ShardedLogStore)
+        log_shards = max(1, GlobalConfig.log_shards)
+        if log_shards > 1:
+            self.logs = ShardedLogStore(
+                shards=log_shards, cap=GlobalConfig.log_cap,
+                rate_per_s=GlobalConfig.log_rate_per_s,
+                dedup_window_s=GlobalConfig.log_dedup_window_s)
+        else:
+            self.logs = LogStore(
+                cap=GlobalConfig.log_cap,
+                rate_per_s=GlobalConfig.log_rate_per_s,
+                dedup_window_s=GlobalConfig.log_dedup_window_s)
+        # graftmeta: the controller self-meters every plane's ingest
+        # path (fold latency, records/bytes per second, drops) plus its
+        # own event-loop lag and RSS — the singleton-aggregator failure
+        # mode is invisible from the outside until nodes start dying,
+        # so the aggregator must carry its own gauge. None when off.
+        from ray_tpu.core._native import graftmeta
+        self.meta = graftmeta.MetaPlane(GlobalConfig.meta_history) \
+            if graftmeta.enabled() else None
+        self._meta_span_min_ns = max(0, GlobalConfig.meta_span_min_us) \
+            * 1000
+        self._meta_task: Optional[asyncio.Task] = None
         # Salvage can outrun the trail: the agent ships a dead worker's
         # ring tail the instant waitpid fires, while the driver's trail
         # flush carrying the task's attempt record is still in flight.
@@ -331,8 +359,31 @@ class Controller:
     # ------------------------------------------------------------------
     # observability (metrics + task events + timeline)
     # ------------------------------------------------------------------
+    def _meta_note(self, plane: str, records: int, nbytes: int,
+                   t0_ns: int) -> None:
+        """Meter one plane fold: t0_ns is the perf_counter_ns taken
+        before the fold, so dur is exactly the event-loop time the
+        fold held. Folds slower than meta_span_min_us additionally
+        land as `meta.fold.<plane>` spans in the native timeline —
+        the controller's own milliseconds become visible in
+        `timeline --native` next to the work they delayed."""
+        if self.meta is None:
+            return
+        dur_ns = time.perf_counter_ns() - t0_ns
+        self.meta.note(plane, records, nbytes, dur_ns)
+        if self._meta_span_min_ns and dur_ns >= self._meta_span_min_ns:
+            now_us = time.time_ns() / 1e3
+            self.native_spans.append({
+                "name": "meta.fold.%s" % plane, "cat": "native",
+                "ts": now_us - dur_ns / 1e3, "dur": dur_ns / 1e3,
+                "pid": "controller", "tid": "meta",
+                "args": {"records": records, "bytes": nbytes},
+            })
+
     async def report_metrics(self, node_id: bytes, snapshot: dict) -> None:
+        t0 = time.perf_counter_ns()
         self.node_metrics[node_id.hex()[:12]] = snapshot
+        self._meta_note("metrics", 1, 0, t0)
 
     async def get_metrics(self) -> dict:
         # Shallow-copy: the reply must be a point-in-time snapshot even
@@ -350,7 +401,13 @@ class Controller:
         the node's ring-buffer series. Malformed frames are dropped (a
         version-skewed agent must not kill the controller); a good pulse
         also clears any suspect state the cadence FSM set."""
-        self.pulse.ingest(node_id.hex()[:12], blob)
+        t0 = time.perf_counter_ns()
+        p = self.pulse.ingest(node_id.hex()[:12], blob)
+        if p is None:
+            if self.meta is not None:
+                self.meta.drop("pulse")
+            return
+        self._meta_note("pulse", 1, len(blob), t0)
 
     _SOAK_STALE_S = 30.0
 
@@ -443,6 +500,28 @@ class Controller:
                     lines.append(f"# HELP {mname} {desc}")
                     lines.append(f"# TYPE {mname} gauge")
                 lines.append(f'{mname}{{op="{name}"}} {o[metric]}')
+        if self.meta is not None:
+            m = self.meta.snapshot()
+            gauge("raytpu_meta_rss_bytes",
+                  "Controller resident set size.", m["rss_bytes"])
+            gauge("raytpu_meta_loop_lag_p99_ns",
+                  "Controller event-loop lag p99 (meta window).",
+                  m["loop_lag"]["p99_ns"])
+            for metric, desc in (
+                    ("records_per_s", "Plane ingest records/s "
+                                      "(meta window)"),
+                    ("bytes_per_s", "Plane ingest bytes/s "
+                                    "(meta window)"),
+                    ("fold_p99_ns", "Plane fold latency p99 "
+                                    "(meta window)"),
+                    ("drops", "Plane frames/records dropped "
+                              "(cumulative)")):
+                mname = f"raytpu_meta_{metric}"
+                lines.append(f"# HELP {mname} {desc}")
+                lines.append(f"# TYPE {mname} gauge")
+                for plane, row in sorted(m["planes"].items()):
+                    lines.append(
+                        f'{mname}{{plane="{plane}"}} {row[metric]}')
         return render_prometheus(self.node_metrics) + "\n" \
             + "\n".join(lines) + "\n"
 
@@ -481,6 +560,7 @@ class Controller:
         transitions the old pipeline knew about — those keep feeding
         the task_events deque and the event exporter so every derived
         view (timeline, export JSONL, list_task_events) is unchanged."""
+        t0 = time.perf_counter_ns()
         derived = []
         for ev in task_events:
             try:
@@ -495,6 +575,10 @@ class Controller:
             except Exception:
                 continue
         self._retry_pending_task_logs()
+        n = len(task_events) + len(object_events)
+        # Nominal ~96B per wire event: trail batches arrive as tuples,
+        # so the meter estimates bytes instead of re-serializing.
+        self._meta_note("trail", n, 96 * n, t0)
         if derived:
             self.task_events.extend(derived)
             if self._event_exporter is not None:
@@ -564,12 +648,20 @@ class Controller:
         """graftprof ingest: one fire-and-forget batch per node per
         flush tick — each payload is one process's folded-stack delta
         for its last ~2s window. Malformed payloads are dropped."""
+        t0 = time.perf_counter_ns()
         hex_id = node_id.hex()[:12]
+        nbytes = 0
         for payload in payloads:
             try:
+                nbytes += (len(payload.get("frames") or ()) * 32
+                           + len(payload.get("stacks") or ()) * 48
+                           + len(payload.get("tasks") or ()) * 48)
                 self.prof.ingest(hex_id, payload)
             except Exception:
+                if self.meta is not None:
+                    self.meta.drop("prof")
                 continue
+        self._meta_note("prof", len(payloads), nbytes, t0)
 
     async def prof_top(self, task=None, actor=None, node=None,
                        seconds=None, limit: int = 30) -> dict:
@@ -604,7 +696,10 @@ class Controller:
         node per log tick — records tailed from the workers' (and the
         agent's own) crash-persistent rings. Dedup/rate caps apply
         inside the store."""
+        t0 = time.perf_counter_ns()
         self.logs.ingest_batch(node_id.hex()[:12], records)
+        nbytes = sum(int(r.get("line_len") or 0) for r in records or ())
+        self._meta_note("log", len(records or ()), nbytes, t0)
 
     @staticmethod
     def _format_log_line(rec: dict) -> str:
@@ -625,8 +720,12 @@ class Controller:
         pressure), and each task mentioned in the tail gets its last
         lines pinned onto its grafttrail attempt record — `get task`
         on a SIGKILL'd task then shows its final words as root cause."""
+        t0 = time.perf_counter_ns()
         hex_id = node_id.hex()[:12]
         self.logs.ingest_batch(hex_id, records, salvaged=True)
+        self._meta_note("log", len(records or ()),
+                        sum(int(r.get("line_len") or 0)
+                            for r in records or ()), t0)
         by_task: Dict[str, list] = {}
         for rec in records or ():
             task = str(rec.get("task") or "")
@@ -667,11 +766,35 @@ class Controller:
     async def log_stats(self) -> dict:
         return self.logs.stats()
 
+    # -- graftmeta (the /api/meta + `ray_tpu status --planes` backend) -
+    async def meta_snapshot(self, window: int = 60) -> dict:
+        """The controller's self-telemetry: per-plane ingest rates +
+        fold-latency percentiles over the last `window` meta ticks,
+        event-loop lag, controller RSS, and each store's occupancy
+        (live caps/eviction/dedup counters straight from the stores)."""
+        if self.meta is None:
+            return {"enabled": False}
+        stores = {
+            "pulse": {"nodes": len(self.pulse.series),
+                      "pulses": sum(len(s.pulses) for s in
+                                    self.pulse.series.values()),
+                      "cap_per_node": self.pulse.history},
+            "trail": self.trail.stats(),
+            "prof": self.prof.stats(),
+            "log": self.logs.stats(),
+            "scope": {"spans": len(self.native_spans),
+                      "oid_trace": len(self._oid_trace)},
+        }
+        snap = self.meta.snapshot(int(window), stores=stores)
+        snap["enabled"] = True
+        return snap
+
     async def report_native_spans(self, spans: list) -> None:
         """graftscope spans from worker flushers / agent metric ticks.
         Put-side spans teach us oid64 -> trace context; sidecar-side
         spans for the same object arrive context-free from the agent
         and get parented at timeline() time."""
+        t0 = time.perf_counter_ns()
         for s in spans:
             oid = s.get("oid64")
             if oid and s.get("trace_id"):
@@ -682,6 +805,7 @@ class Controller:
             for k in list(self._oid_trace)[:50000]:
                 del self._oid_trace[k]
         self.native_spans.extend(spans)
+        self._meta_note("scope", len(spans), 64 * len(spans), t0)
 
     async def native_latency(self) -> list:
         """Hot-path latency rollup over the retained native spans, for
@@ -828,11 +952,13 @@ class Controller:
         flow one way, the periodic heartbeat remains the anti-entropy
         backstop). Keeps controller-side spillback picks honest between
         heartbeats without any awaited round-trip on the grant path."""
+        t0 = time.perf_counter_ns()
         node = self.nodes.get(node_id)
         if node is None or node.state != NodeState.ALIVE:
             return
         node.resources_available = resources_available
         node.num_leases = num_leases
+        self._meta_note("sched", 1, 0, t0)
 
     async def get_nodes(self) -> list:
         return [{
@@ -932,6 +1058,23 @@ class Controller:
                 await self._reconcile_bundles()
             if self._event_exporter is not None:
                 self._event_exporter.flush()
+
+    async def _meta_loop(self) -> None:
+        """graftmeta tick: sample event-loop lag as this sleep's own
+        overshoot (every handler that ran on the loop between two ticks
+        is what delayed the wakeup — the exact number that predicts
+        heartbeat/pulse starvation), then snapshot all plane meters +
+        controller RSS into the bounded tick ring."""
+        import os
+        from ray_tpu.core._native.graftpulse import proc_rss_bytes
+        period = max(0.05, GlobalConfig.meta_tick_ms / 1000)
+        pid = os.getpid()
+        while True:
+            t0 = time.monotonic()
+            await asyncio.sleep(period)
+            lag_s = time.monotonic() - t0 - period
+            self.meta.loop_lag(int(lag_s * 1e9))
+            self.meta.tick(proc_rss_bytes(pid))
 
     async def _reconcile_bundles(self) -> None:
         """Release ORPHANED bundle reservations on agents: a controller
@@ -1578,6 +1721,8 @@ class Controller:
         port = await server.start_tcp(host, port)
         self._server = server
         self._health_task = spawn(self._health_loop())
+        if self.meta is not None:
+            self._meta_task = spawn(self._meta_loop())
         if self._storage_path:
             spawn(self._persist_loop())
             spawn(self._resume_restored())

@@ -1333,6 +1333,29 @@ class NodeAgent:
         except Exception:
             self._sched_sync_scheduled = False  # next change re-arms
 
+    def _resolve_bundle(self, pg: bytes, bundle_index: int,
+                        resources: dict) -> int:
+        """Resolve the default ``bundle_index=-1`` ("any bundle of the
+        PG") to a concrete COMMITTED bundle on this node — the
+        ``bundle_available`` pools are keyed by concrete index, so an
+        unresolved -1 never matches and the request would park forever.
+        Prefers the lowest-indexed bundle whose remaining reservation
+        fits ``resources``; falls back to any local bundle of the PG
+        (so the request parks on a real pool and wakes when leases
+        return); returns -1 when this node hosts none."""
+        if bundle_index >= 0:
+            return bundle_index
+        best = fallback = -1
+        for (pg_id, idx), avail in self.bundle_available.items():
+            if pg_id != pg:
+                continue
+            if resources_fit(avail, resources):
+                if best < 0 or idx < best:
+                    best = idx
+            elif fallback < 0 or idx < fallback:
+                fallback = idx
+        return best if best >= 0 else fallback
+
     @long_poll
     async def request_lease_batch(self, count: int, resources: dict,
                                   pg: Optional[bytes] = None,
@@ -1352,14 +1375,16 @@ class NodeAgent:
             labels_match(self.labels, label_selector)
             and self._strategy_allows_local(strategy))
         while local_ok and len(granted) < count:
-            avail = (self.bundle_available.get((pg, bundle_index))
+            b = (self._resolve_bundle(pg, bundle_index, resources)
+                 if pg is not None else bundle_index)
+            avail = (self.bundle_available.get((pg, b))
                      if pg is not None else self.resources_available)
             if avail is None or not resources_fit(avail, resources):
                 break
             # FIFO fairness vs already-parked single requests: a batch
             # must not jump a satisfiable earlier waiter.
             if self._lease_waiters and self._lease_head_blocked(
-                    self._lease_ticket_seq + 1, avail, pg, bundle_index):
+                    self._lease_ticket_seq + 1, avail, pg, b):
                 break
             if granted and not self.idle_workers:
                 # Only the first grant of a wave may wait on a worker
@@ -1374,8 +1399,7 @@ class NodeAgent:
                 break
             r = self._mint_lease()
             w.current_lease = r["lease_id"]
-            self.leases[r["lease_id"]] = (w, dict(resources), pg,
-                                          bundle_index)
+            self.leases[r["lease_id"]] = (w, dict(resources), pg, b)
             r["worker_addr"] = w.addr
             granted.append(r)
         if granted:
@@ -1438,8 +1462,14 @@ class NodeAgent:
         for t, w in self._lease_waiters.items():
             if t >= ticket:
                 continue
-            if (w["pg"], w["bundle"]) != (pg, bundle_index):
+            if w["pg"] != pg:
                 continue  # disjoint pools can't contend
+            # A -1 waiter ("any bundle of the PG") may resolve to THIS
+            # bundle's pool, so it contends with every index; only two
+            # CONCRETE, different indexes are provably disjoint.
+            if (w["bundle"] >= 0 and bundle_index >= 0
+                    and w["bundle"] != bundle_index):
+                continue
             if w["pg"] is None and not (
                     labels_match(self.labels, w["labels"])
                     and self._strategy_allows_local(w["strategy"])):
@@ -1456,15 +1486,29 @@ class NodeAgent:
         loop = asyncio.get_running_loop()
         while True:
             # Placement-group tasks must run on the bundle's node.
-            if pg is not None and (pg, bundle_index) not in self.bundle_available \
+            # Resolve the default bundle_index=-1 to a concrete local
+            # bundle each pass — commits and returned leases between
+            # parks can change which bundle (if any) fits.
+            b = (self._resolve_bundle(pg, bundle_index, resources)
+                 if pg is not None else bundle_index)
+            if pg is not None and (pg, b) not in self.bundle_available \
                     and not _no_spill:
                 info = await self.controller.call("get_pg_info", pg)
                 if info is None or info["state"] != "CREATED":
                     if not await self._park_until(deadline):
                         return {"granted": False, "retry": True}
                     continue
-                node_id = info["bundle_nodes"][bundle_index if bundle_index >= 0 else 0]
-                if node_id != self.node_id.binary():
+                if bundle_index >= 0:
+                    node_id = info["bundle_nodes"][bundle_index]
+                else:
+                    # -1 with no local bundle: any node hosting one of
+                    # the PG's bundles will do; its agent re-resolves.
+                    node_id = next(
+                        (n for n in info["bundle_nodes"]
+                         if n is not None
+                         and n != self.node_id.binary()), None)
+                if node_id is not None \
+                        and node_id != self.node_id.binary():
                     nodes = await self.controller.call("get_nodes")
                     for n in nodes:
                         if n["node_id"] == node_id:
@@ -1480,13 +1524,13 @@ class NodeAgent:
             local_ok = pg is not None or (
                 labels_match(self.labels, label_selector)
                 and self._strategy_allows_local(strategy))
-            avail = (self.bundle_available.get((pg, bundle_index))
+            avail = (self.bundle_available.get((pg, b))
                      if pg is not None else self.resources_available)
             if not local_ok:
                 avail = None
             if avail is not None and resources_fit(avail, resources) \
                     and not self._lease_head_blocked(ticket, avail, pg,
-                                                     bundle_index):
+                                                     b):
                 resources_sub(avail, resources)
                 try:
                     w = await self._pop_worker()
@@ -1495,8 +1539,9 @@ class NodeAgent:
                     return {"granted": False, "retry": True, "error": repr(e)}
                 r = self._mint_lease()
                 w.current_lease = r["lease_id"]
-                self.leases[r["lease_id"]] = (w, dict(resources), pg,
-                                              bundle_index)
+                # Store the RESOLVED index so return_lease credits the
+                # bundle pool the grant actually drew from.
+                self.leases[r["lease_id"]] = (w, dict(resources), pg, b)
                 r["worker_addr"] = w.addr
                 self._mark_sched_dirty()
                 return r
@@ -1666,6 +1711,9 @@ class NodeAgent:
             # chip pinning from the resource vector.
             raise ValueError(f"TPU requests must be whole chips, got "
                              f"{tpu_req}")
+        if pg is not None:
+            bundle_index = self._resolve_bundle(pg, bundle_index,
+                                                resources)
         avail = (self.bundle_available.get((pg, bundle_index))
                  if pg is not None else self.resources_available)
         if avail is None or not resources_fit(avail, resources):

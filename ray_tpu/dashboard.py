@@ -26,6 +26,11 @@ React client is out of scope). Endpoints:
     GET /api/prof?view=top|flame|collapsed|stats&task=&actor=&node=
                  &seconds=&limit=
                          -> graftprof continuous-profiling queries
+    GET /api/meta?window=N
+                         -> graftmeta self-telemetry (per-plane ingest
+                            rates + fold p50/p99 over the last N meta
+                            ticks, controller loop lag + RSS, store
+                            occupancy)
     GET /flame           -> self-contained flamegraph view over /api/prof
     GET /metrics         -> Prometheus text exposition
     GET /metrics/cluster -> federated exposition + raytpu_cluster_*
@@ -74,7 +79,7 @@ _PAGE = """<!doctype html>
 <a href="/api/nodes">nodes</a> · <a href="/api/actors">actors</a> ·
 <a href="/api/tasks">tasks</a> · <a href="/api/workers">workers</a> ·
 <a href="/api/jobs">jobs</a> · <a href="/api/native">native</a> ·
-<a href="/api/cluster">cluster</a> ·
+<a href="/api/cluster">cluster</a> · <a href="/api/meta">meta</a> ·
 <a href="/api/prof?view=top">prof</a> · <a href="/flame">flame</a> ·
 <a href="/api/logs?tail=100">logs</a> ·
 <a href="/api/timeline">timeline</a> · <a href="/metrics">metrics</a> ·
@@ -344,6 +349,14 @@ class _Handler(BaseHTTPRequestHandler):
                 grace = q.get("grace")
                 self._send(200, json.dumps(
                     state.audit(float(grace) if grace else None),
+                    default=str).encode())
+                return
+            if path == "/api/meta":
+                # graftmeta: the controller's self-telemetry — plane
+                # ingest rates, fold-latency percentiles, loop lag,
+                # RSS, store occupancy. ?window=N in meta ticks.
+                self._send(200, json.dumps(state.meta_snapshot(
+                    window=int(q.get("window", 60) or 60)),
                     default=str).encode())
                 return
             if path == "/api/cluster":

@@ -136,6 +136,15 @@ def cluster_telemetry(window: int = 30) -> dict:
     return _ctl("cluster_telemetry", window)
 
 
+def meta_snapshot(window: int = 60) -> dict:
+    """The graftmeta self-telemetry view: per-plane ingest records/s +
+    bytes/s and fold-latency p50/p99 over the last `window` meta ticks,
+    controller event-loop lag, controller RSS, and per-store occupancy
+    (caps, evictions, dedup hits). {"enabled": False} when the meter is
+    off (RAY_TPU_GRAFTMETA=0)."""
+    return _ctl("meta_snapshot", window)
+
+
 def report_soak(status: dict) -> None:
     """Push a running soak's status blob to the controller (graftload's
     1 Hz reporter). Shows up as `soak` in cluster_telemetry() / the

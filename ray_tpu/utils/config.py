@@ -164,6 +164,12 @@ _flag("log_dedup_window_s", float, 5.0, "Error-storm dedup: an identical (node, 
 _flag("log_tail_lines", int, 200, "Ring records salvaged from a dead worker's logring file and attached (last 20) to its grafttrail attempt record.")
 _flag("log_file_max_bytes", int, 16 << 20, "Rotation threshold for session logs/<component>-<pid>.log files (0 = unbounded legacy behavior).")
 _flag("log_file_backups", int, 3, "Rotated session log files kept per component.")
+_flag("graftmeta", bool, True, "Plane self-telemetry (graftmeta): the controller meters every observability plane's own fold path — per-plane ingest records/s and bytes/s, fold-latency log2 histograms, store occupancy/eviction/dedup counters, event-loop lag, controller RSS — in a bounded ring behind /api/meta, /metrics/cluster gauges and `ray_tpu status --planes`. RAY_TPU_GRAFTMETA=0 disables the meter (handlers skip the timing wrap).")
+_flag("meta_history", int, 600, "Meta-plane ticks retained in the controller self-telemetry ring (one tick per meta_tick_ms).")
+_flag("meta_tick_ms", int, 1000, "graftmeta tick period: loop-lag probe + RSS sample + counter snapshot per tick.")
+_flag("meta_span_min_us", int, 1000, "Plane folds at least this slow emit a controller-side 'meta.fold.<plane>' span into the native timeline (`timeline --native`); 0 disables span emission.")
+_flag("log_shards", int, 8, "Controller LogStore shards (node-hash partitioned, per-shard lock and eviction); 1 restores the single-store layout.")
+_flag("prof_shards", int, 8, "Controller ProfStore shards (node-hash partitioned ingest, merged on query); 1 restores the single-store layout.")
 
 
 class Config:

@@ -147,6 +147,44 @@ def test_aggregator_window_bounds_aggregates():
     assert one["ops"]["rpc_send"]["calls_per_s"] == 0.0
 
 
+def test_mixed_version_fold_degrades_node_not_cluster():
+    """Version skew: a v1-pulse node in a v2 cluster must degrade that
+    NODE's row (wire_version + degraded marker, prof gauges zeroed) —
+    its real data still folds, the cluster aggregates stay sound, and
+    an unknown future version is dropped, never poisoning the fold."""
+    from ray_tpu.scale.simnode import SimNode
+    agg = graftpulse.ClusterAggregator(history=10)
+    k = {"rpc_send": (10, 1000, 5_000, _hist(b0=10))}
+    for seq in (1, 2):
+        agg.ingest("aaa", graftpulse.encode(
+            _pulse(seq=seq, t_mono_ns=seq * 10**9, kinds=k,
+                   prof_oncpu_permille=500)))
+        agg.ingest("bbb", SimNode._encode_v1(
+            _pulse(seq=seq, t_mono_ns=seq * 10**9, queue_depth=3,
+                   kinds=k, prof_oncpu_permille=500)))
+    # The v1 frame is exactly the registry's v1 size (96B header).
+    blob = SimNode._encode_v1(_pulse(seq=3, kinds=k))
+    assert len(blob) - 11 * (3 + graftpulse.PULSE_HIST_BUCKETS) * 8 \
+        == graftpulse.PULSE_VERSION_SIZES[1]
+    p = graftpulse.decode(blob)
+    assert p.version == 1 and p.seq == 3
+    assert p.prof_oncpu_permille == 0  # missing v1 fields zero-fill
+    snap = agg.snapshot()
+    assert snap["nodes"]["bbb"]["degraded"] is True
+    assert snap["nodes"]["bbb"]["wire_version"] == 1
+    assert "degraded" not in snap["nodes"]["aaa"]
+    assert snap["nodes"]["aaa"]["wire_version"] == graftpulse.PULSE_VERSION
+    # Both nodes' op deltas fold: the skewed node is degraded, not mute.
+    assert snap["ops"]["rpc_send"]["calls"] == 40
+    assert snap["totals"]["queue_depth"] == 3
+    assert snap["nodes"]["bbb"]["health"] == "alive"
+    # An unknown FUTURE version is a drop, not an exception or a fold.
+    v3 = bytearray(graftpulse.encode(_pulse(seq=9, kinds=k)))
+    v3[4:6] = (3).to_bytes(2, "little")
+    assert agg.ingest("ccc", bytes(v3)) is None
+    assert "ccc" not in agg.series
+
+
 def test_assembler_emits_deltas_not_cumulatives(monkeypatch):
     from ray_tpu.core._native import graftscope
     calls = {"n": 0}

@@ -253,8 +253,9 @@ def test_pg_oneop_ready_is_local(sched_cluster):
     assert pg._state == "CREATED"
     assert pg.ready(timeout=1.0) is True
 
-    @ray_tpu.remote(num_cpus=1, placement_group=pg,
-                    placement_group_bundle_index=0)
+    # No bundle_index: the default -1 ("any bundle of the PG") must
+    # resolve to a committed bundle at the agent, not hang.
+    @ray_tpu.remote(num_cpus=1, placement_group=pg)
     def inside():
         return "pg-ok"
 
@@ -265,6 +266,28 @@ def test_pg_oneop_ready_is_local(sched_cluster):
     assert pg._state is None
     with pytest.raises(Exception, match="no such placement group"):
         pg.ready(timeout=5.0)
+
+
+def test_pg_default_bundle_index_resolves(sched_cluster):
+    # Regression: the agent's bundle pools are keyed by CONCRETE
+    # (pg, index); the default bundle_index=-1 used to miss every pool
+    # and park forever (and the remote path hard-pinned -1 to bundle
+    # 0's node). It must resolve to any committed bundle with room —
+    # including the SECOND bundle once the first is exhausted.
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}])
+    assert pg.ready(timeout=30.0)
+    r1 = _agent_call("request_lease", {"CPU": 1}, pg.id.binary(), -1)
+    assert r1.get("granted"), r1
+    r2 = _agent_call("request_lease", {"CPU": 1}, pg.id.binary(), -1)
+    assert r2.get("granted"), r2
+    # Both bundle pools are now empty: a third -1 request parks and
+    # times out instead of granting (or crashing on the miss).
+    r3 = _agent_call("request_lease", {"CPU": 1}, pg.id.binary(), -1,
+                     None, None, False, 500)
+    assert not r3.get("granted") and r3.get("retry"), r3
+    for r in (r1, r2):
+        _agent_call("return_lease", r["lease_id"])
+    ray_tpu.remove_placement_group(pg)
 
 
 def test_pg_oneop_infeasible_falls_back_pending(sched_cluster):
@@ -361,8 +384,7 @@ pg = ray_tpu.placement_group([{"CPU": 1}])
 assert pg._state != "CREATED"
 assert pg.ready(timeout=60)
 
-@ray_tpu.remote(num_cpus=1, placement_group=pg,
-                placement_group_bundle_index=0)
+@ray_tpu.remote(num_cpus=1, placement_group=pg)
 def inside():
     return "pg-ok"
 
